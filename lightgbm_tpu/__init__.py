@@ -1,0 +1,29 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch re-design of the LightGBM feature set (reference:
+hdyen/LightGBM v2.0.5) for TPU hardware: histogram construction and
+leaf-wise tree growth run as jitted XLA/Pallas programs, distribution uses
+``jax.sharding`` meshes with XLA collectives over ICI/DCN, and the host data
+layer (binning, parsing, model IO) mirrors the reference's semantics so
+models and APIs interoperate.
+"""
+from .basic import Booster, Dataset
+from .callback import (EarlyStopException, early_stopping, print_evaluation,
+                       record_evaluation, reset_parameter)
+from .config import Config
+from .engine import cv, train
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Booster", "Dataset", "Config", "train", "cv",
+    "early_stopping", "print_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
+]
+
+try:  # sklearn API is optional at import time
+    from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,  # noqa: F401
+                          LGBMRegressor)
+    __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,373 @@
+"""Public ``Dataset`` / ``Booster`` API.
+
+Mirrors ``python-package/lightgbm/basic.py`` (Dataset :548-1210,
+Booster :1213-1854) but binds directly to the in-process TPU engine instead of
+ctypes into a C library: lazy construction, reference-aligned validation
+datasets, pandas passthrough, model save/load, training loop primitives.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import data as data_mod
+from .boosting import GBDT, create_boosting
+from .config import Config, canonicalize_params, config_from_params
+from .data.dataset import TrainingData, construct
+from .data.parser import load_text_file
+from .objectives import create_objective
+from .utils import log
+
+
+def _to_matrix(data) -> np.ndarray:
+    if hasattr(data, "values"):         # pandas DataFrame / Series
+        data = data.values
+    arr = np.asarray(data)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr
+
+
+class Dataset:
+    """Lazily-constructed training dataset (basic.py:548+ semantics)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = False, silent: bool = False):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._constructed: Optional[TrainingData] = None
+        self.raw: Optional[np.ndarray] = None
+
+    # -- lazy construction --------------------------------------------------
+
+    def construct(self, config: Optional[Config] = None) -> "Dataset":
+        if self._constructed is not None:
+            return self
+        cfg = config or config_from_params(self.params)
+        if isinstance(self.data, (str, os.PathLike)):
+            path = str(self.data)
+            feats, labels, names = load_text_file(
+                path, has_header=cfg.has_header, label_idx=0)
+            if self.label is None:
+                self.label = labels
+            mat = feats
+            if names and self.feature_name == "auto":
+                self.feature_name = names
+            # side files: .weight / .query / .init
+            meta_probe = data_mod.Metadata(len(labels))
+            meta_probe.load_side_files(path)
+            if self.weight is None and meta_probe.weight is not None:
+                self.weight = meta_probe.weight
+            if self.group is None and meta_probe.query_boundaries is not None:
+                self.group = np.diff(meta_probe.query_boundaries)
+            if self.init_score is None and meta_probe.init_score is not None:
+                self.init_score = meta_probe.init_score
+        else:
+            mat = _to_matrix(self.data)
+
+        cat_idx: List[int] = []
+        names: Optional[List[str]] = None
+        if isinstance(self.feature_name, (list, tuple)):
+            names = list(self.feature_name)
+        if hasattr(self.data, "columns"):   # pandas
+            cols = [str(c) for c in self.data.columns]
+            if names is None:
+                names = cols
+            if self.categorical_feature not in ("auto", None):
+                for c in self.categorical_feature:
+                    cat_idx.append(cols.index(c) if isinstance(c, str)
+                                   else int(c))
+        elif isinstance(self.categorical_feature, (list, tuple)):
+            for c in self.categorical_feature:
+                if isinstance(c, str) and names and c in names:
+                    cat_idx.append(names.index(c))
+                elif not isinstance(c, str):
+                    cat_idx.append(int(c))
+
+        ref = self.reference.construct(config)._constructed \
+            if self.reference is not None else None
+        label = np.asarray(self.label, dtype=np.float32).ravel() \
+            if self.label is not None else None
+        self._constructed = construct(
+            mat, cfg, label=label,
+            weight=None if self.weight is None else np.asarray(self.weight),
+            group=None if self.group is None else np.asarray(self.group),
+            init_score=None if self.init_score is None
+            else np.asarray(self.init_score),
+            feature_names=names, categorical_features=cat_idx, reference=ref)
+        self.raw = mat if not self.free_raw_data else None
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    @property
+    def constructed(self) -> TrainingData:
+        if self._constructed is None:
+            self.construct()
+        return self._constructed
+
+    # -- reference-like helpers --------------------------------------------
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._constructed is not None:
+            self._constructed.metadata.set_label(np.asarray(label))
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._constructed is not None:
+            self._constructed.metadata.set_weight(
+                None if weight is None else np.asarray(weight))
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._constructed is not None:
+            self._constructed.metadata.set_query(
+                None if group is None else np.asarray(group))
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._constructed is not None:
+            self._constructed.metadata.set_init_score(
+                None if init_score is None else np.asarray(init_score))
+        return self
+
+    def get_label(self):
+        return (np.asarray(self.constructed.metadata.label)
+                if self.constructed.metadata.label is not None else None)
+
+    def get_weight(self):
+        return self.constructed.metadata.weight
+
+    def get_group(self):
+        qb = self.constructed.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
+
+    def num_data(self) -> int:
+        return self.constructed.num_data
+
+    def num_feature(self) -> int:
+        return self.constructed.num_total_features
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Binary dataset cache (Dataset::SaveBinaryFile analogue, npz based)."""
+        c = self.constructed
+        import pickle
+        with open(filename, "wb") as f:
+            pickle.dump({
+                "binned": c.binned, "used_features": c.used_features,
+                "bin_mappers": c.bin_mappers, "feature_names": c.feature_names,
+                "num_total_features": c.num_total_features,
+                "label": c.metadata.label, "weight": c.metadata.weight,
+                "query_boundaries": c.metadata.query_boundaries,
+                "init_score": c.metadata.init_score}, f)
+        return self
+
+    @staticmethod
+    def load_binary(filename: str) -> "Dataset":
+        import pickle
+        with open(filename, "rb") as f:
+            state = pickle.load(f)
+        ds = Dataset(None)
+        td = TrainingData()
+        td.binned = state["binned"]
+        td.used_features = state["used_features"]
+        td.bin_mappers = state["bin_mappers"]
+        td.feature_names = state["feature_names"]
+        td.num_total_features = state["num_total_features"]
+        td.num_data = len(state["binned"])
+        td.metadata = data_mod.Metadata(td.num_data)
+        td.metadata.set_label(state["label"])
+        td.metadata.set_weight(state["weight"])
+        td.metadata.query_boundaries = state["query_boundaries"]
+        td.metadata.set_init_score(state["init_score"])
+        ds._constructed = td
+        return ds
+
+
+class Booster:
+    """Training/prediction handle (basic.py:1213+ semantics)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent: bool = False):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._train_dataset = train_set
+        if train_set is not None:
+            cfg = config_from_params(self.params)
+            log.set_verbosity(cfg.verbose)
+            train_set.construct(cfg)
+            objective = create_objective(cfg)
+            self.inner: GBDT = create_boosting(cfg, train_set.constructed,
+                                               objective)
+        elif model_file is not None:
+            with open(model_file) as f:
+                self.inner = GBDT.load_from_string(
+                    f.read(), config_from_params(self.params))
+        elif model_str is not None:
+            self.inner = GBDT.load_from_string(
+                model_str, config_from_params(self.params))
+        else:
+            raise ValueError("Booster needs train_set, model_file or model_str")
+
+    # -- training loop primitives ------------------------------------------
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct(self.inner.config)
+        self.inner.add_valid_set(data.constructed, name)
+        self._valid_datasets = getattr(self, "_valid_datasets", [])
+        self._valid_datasets.append(data)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; custom objective fobj(preds, train_data) ->
+        (grad, hess) like the reference."""
+        if fobj is None:
+            return self.inner.train_one_iter()
+        scores = np.asarray(self.inner.scores, np.float64)
+        preds = scores.reshape(-1) if scores.shape[0] > 1 else scores[0]
+        grad, hess = fobj(preds, self._train_dataset)
+        return self.inner.train_one_iter(np.asarray(grad), np.asarray(hess))
+
+    def rollback_one_iter(self) -> "Booster":
+        self.inner.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self.inner.current_iteration()
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        canon = canonicalize_params(params)
+        for k, v in canon.items():
+            setattr(self.inner.config, k, type(getattr(self.inner.config, k))(v)
+                    if not isinstance(getattr(self.inner.config, k), list) else v)
+        return self
+
+    # -- evaluation ---------------------------------------------------------
+
+    def eval_train(self, feval=None):
+        res = self.inner.eval_train()
+        return self._add_feval(res, "training", feval,
+                               self.inner.scores, self._train_dataset)
+
+    def eval_valid(self, feval=None):
+        res = self.inner.eval_valid()
+        if feval is not None:
+            datasets = getattr(self, "_valid_datasets", [])
+            for i, vs in enumerate(self.inner.valid_sets):
+                ds = datasets[i] if i < len(datasets) else None
+                res = self._add_feval(res, vs.name, feval, vs.scores, ds)
+        return res
+
+    def _add_feval(self, res, name, feval, scores, dataset):
+        if feval is not None:
+            scores = np.asarray(scores, np.float64)
+            preds = scores.reshape(-1) if scores.shape[0] > 1 else scores[0]
+            out = feval(preds, dataset)
+            if isinstance(out, tuple):
+                out = [out]
+            for metric, value, is_higher_better in out:
+                res = list(res) + [(name, metric, value, is_higher_better)]
+        return res
+
+    # -- prediction / io ----------------------------------------------------
+
+    def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
+                pred_leaf: bool = False, pred_early_stop: bool = False,
+                **kwargs):
+        if isinstance(data, (str, os.PathLike)):
+            feats, _, _ = load_text_file(str(data),
+                                         has_header=self.inner.config.has_header)
+            data = feats
+        else:
+            data = _to_matrix(data)
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return self.inner.predict(data, num_iteration=num_iteration,
+                                  raw_score=raw_score, pred_leaf=pred_leaf,
+                                  pred_early_stop=pred_early_stop)
+
+    def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        self.inner.save_model(filename, num_iteration)
+        return self
+
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        return self.inner.save_model_to_string(num_iteration)
+
+    def dump_model(self, num_iteration: int = -1) -> Dict:
+        """JSON model dump (gbdt.cpp DumpModel)."""
+        inner = self.inner
+        trees = inner.models
+        if num_iteration > 0:
+            cut = (num_iteration + (1 if inner.boost_from_average_ else 0)) \
+                * inner.num_class
+            trees = trees[:cut]
+        return {
+            "name": "tree",
+            "version": "v2",
+            "num_class": inner.num_class,
+            "num_tree_per_iteration": inner.num_class,
+            "label_index": inner.label_idx,
+            "max_feature_idx": inner.max_feature_idx,
+            "objective": inner.objective.to_string() if inner.objective else "",
+            "average_output": inner.average_output,
+            "feature_names": inner.feature_names,
+            "tree_info": [t.to_json(i) for i, t in enumerate(trees)],
+        }
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        return self.inner.feature_importance(importance_type, iteration)
+
+    def feature_name(self) -> List[str]:
+        return list(self.inner.feature_names)
+
+    def num_trees(self) -> int:
+        return len(self.inner.models)
+
+    def num_feature(self) -> int:
+        return self.inner.max_feature_idx + 1
+
+    # pickle support: serialize via model string
+    def __getstate__(self):
+        state = {"params": self.params,
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score,
+                 "model_str": self.inner.save_model_to_string(-1)}
+        return state
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.best_iteration = state["best_iteration"]
+        self.best_score = state["best_score"]
+        self._train_dataset = None
+        self.inner = GBDT.load_from_string(
+            state["model_str"], config_from_params(self.params))

@@ -1,0 +1,131 @@
+"""Command-line application — the reference CLI's analogue.
+
+``lightgbm-tpu config=train.conf [key=value ...]`` mirrors
+``src/application/application.cpp`` + ``src/main.cpp``: k=v args merged over a
+config file (CLI wins), task dispatch train / predict / convert_model, data
+loaded from text files with ``.weight``/``.query`` side files, models in the
+reference text format.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config, config_from_params, parse_config_file
+from .engine import train as train_fn
+from .utils import log
+
+
+def parse_cli(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            log.warning("Unknown CLI argument %s (expected key=value)", arg)
+            continue
+        k, v = arg.split("=", 1)
+        params[k.strip()] = v.strip()
+    if "config" in params or "config_file" in params:
+        path = params.pop("config", None) or params.pop("config_file")
+        file_params = parse_config_file(path)
+        for k, v in file_params.items():
+            params.setdefault(k, v)  # CLI args win (application.cpp:48-104)
+    return params
+
+
+def run_train(cfg: Config, params: Dict[str, str]) -> None:
+    if not cfg.data:
+        log.fatal("No training data specified (data=...)")
+    dtrain = Dataset(cfg.data, params=params)
+    valid_sets, valid_names = [], []
+    for i, vpath in enumerate(cfg.valid_data):
+        valid_sets.append(dtrain.create_valid(vpath))
+        valid_names.append(f"valid_{i + 1}")
+    if cfg.is_training_metric:
+        valid_sets = [dtrain] + valid_sets
+        valid_names = ["training"] + valid_names
+    booster = train_fn(dict(params), dtrain,
+                       num_boost_round=cfg.num_iterations,
+                       valid_sets=valid_sets, valid_names=valid_names,
+                       early_stopping_rounds=cfg.early_stopping_round or None,
+                       verbose_eval=cfg.output_freq if cfg.verbose >= 1 else False)
+    booster.save_model(cfg.output_model)
+    log.info("Finished training; model saved to %s", cfg.output_model)
+
+
+def run_predict(cfg: Config, params: Dict[str, str]) -> None:
+    if not cfg.data:
+        log.fatal("No prediction data specified (data=...)")
+    if not cfg.input_model:
+        log.fatal("No model specified (input_model=...)")
+    booster = Booster(model_file=cfg.input_model, params=params)
+    preds = booster.predict(cfg.data,
+                            num_iteration=cfg.num_iteration_predict,
+                            raw_score=cfg.is_predict_raw_score,
+                            pred_leaf=cfg.is_predict_leaf_index,
+                            pred_early_stop=cfg.pred_early_stop)
+    out = np.atleast_2d(np.asarray(preds))
+    if out.shape[0] == 1:
+        out = out.T
+    np.savetxt(cfg.output_result, out, delimiter="\t", fmt="%.18g")
+    log.info("Finished prediction; results saved to %s", cfg.output_result)
+
+
+def run_convert_model(cfg: Config, params: Dict[str, str]) -> None:
+    """convert_model task: emit the model as portable C++ if-else code
+    (gbdt.cpp ModelToIfElse analogue; simplified standalone function)."""
+    booster = Booster(model_file=cfg.input_model, params=params)
+    trees = booster.inner.models
+    lines = ["#include <cmath>", "#include <vector>", "",
+             "double PredictRaw(const double* fval) {", "  double sum = 0.0;"]
+    for ti, t in enumerate(trees):
+        lines.append(f"  // tree {ti}")
+        def emit(node, indent):
+            pad = "  " * indent
+            if node < 0:
+                leaf = ~node
+                lines.append(f"{pad}sum += {t.leaf_value[leaf]:.17g};")
+                return
+            f = int(t.split_feature[node])
+            thr = float(t.threshold[node])
+            mt = t.missing_type(node)
+            dl = t.default_left(node)
+            cond = f"fval[{f}] <= {thr:.17g}"
+            if mt == 2:
+                cond = (f"(std::isnan(fval[{f}]) ? {str(dl).lower()} : ({cond}))")
+            lines.append(f"{pad}if ({cond}) {{")
+            emit(int(t.left_child[node]), indent + 1)
+            lines.append(f"{pad}}} else {{")
+            emit(int(t.right_child[node]), indent + 1)
+            lines.append(f"{pad}}}")
+        if t.num_leaves > 1:
+            emit(0, 1)
+        else:
+            lines.append(f"  sum += {t.leaf_value[0]:.17g};")
+    lines += ["  return sum;", "}"]
+    with open(cfg.convert_model, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    log.info("Model converted to %s", cfg.convert_model)
+
+
+def main(argv: List[str] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    params = parse_cli(argv)
+    cfg = config_from_params(params)
+    log.set_verbosity(cfg.verbose)
+    task = params.get("task", "train")
+    if task == "train":
+        run_train(cfg, params)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(cfg, params)
+    elif task == "convert_model":
+        run_convert_model(cfg, params)
+    else:
+        log.fatal("Unknown task %s", task)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,336 @@
+"""Parameter system.
+
+Re-creates the reference's config surface (``include/LightGBM/config.h``):
+the ~90-entry alias table (``config.h:353-483``), defaults, unknown-parameter
+rejection, and the cross-field conflict checks (``src/io/config.cpp:188-240``)
+— as one flat typed dataclass instead of the C++ struct hierarchy
+``OverallConfig{IOConfig, BoostingConfig{TreeConfig}, ...}``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from .utils import log
+
+# Alias -> canonical name (reference config.h:353-483, KeyAliasTransform).
+PARAM_ALIASES: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "num_thread": "num_threads",
+    "random_seed": "seed",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "pre_partition": "is_pre_partition",
+    "training_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "eval_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+    "unbalanced_sets": "is_unbalance",
+    "bagging_fraction_seed": "bagging_seed",
+}
+
+
+@dataclasses.dataclass
+class Config:
+    """Flat parameter set with reference defaults (config.h:94-295)."""
+
+    # task / infra
+    task: str = "train"
+    device: str = "tpu"            # reference: cpu|gpu; here: tpu|cpu (cpu = same XLA path on host)
+    seed: int = 0
+    num_threads: int = 0
+    verbose: int = 1
+
+    # objective / boosting
+    objective: str = "regression"
+    boosting_type: str = "gbdt"
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_class: int = 1
+    tree_learner: str = "serial"   # serial | feature | data | voting
+
+    # tree
+    num_leaves: int = 31
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    feature_fraction: float = 1.0
+    feature_fraction_seed: int = 2
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    top_rate: float = 0.2          # GOSS
+    other_rate: float = 0.1        # GOSS
+    top_k: int = 20                # voting parallel
+    histogram_pool_size: float = -1.0
+
+    # categorical handling (feature_histogram.hpp:113-223)
+    max_cat_group: int = 64
+    max_cat_threshold: int = 256
+    cat_smooth_ratio: float = 0.01
+    min_cat_smooth: float = 5.0
+    max_cat_smooth: float = 100.0
+
+    # IO / binning
+    max_bin: int = 255
+    min_data_in_bin: int = 5
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    enable_bundle: bool = True
+    is_enable_sparse: bool = True
+    sparse_threshold: float = 0.8
+    max_conflict_rate: float = 0.0
+    is_pre_partition: bool = False
+    two_round: bool = False
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_column: str = ""
+
+    # objectives' knobs
+    sigmoid: float = 1.0
+    huber_delta: float = 1.0
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    gaussian_eta: float = 1.0
+    scale_pos_weight: float = 1.0
+    is_unbalance: bool = False
+    boost_from_average: bool = True
+    max_position: int = 20
+    label_gain: Optional[List[float]] = None
+
+    # DART
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+
+    # metric / eval
+    metric: List[str] = dataclasses.field(default_factory=list)
+    metric_freq: int = 1
+    is_training_metric: bool = False
+    ndcg_eval_at: List[int] = dataclasses.field(default_factory=lambda: [1, 2, 3, 4, 5])
+    early_stopping_round: int = 0
+    output_freq: int = 1
+
+    # prediction
+    num_iteration_predict: int = -1
+    is_predict_raw_score: bool = False
+    is_predict_leaf_index: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+
+    # model io
+    output_model: str = "LightGBM_model.txt"
+    input_model: str = ""
+    output_result: str = "LightGBM_predict_result.txt"
+    snapshot_freq: int = -1
+    convert_model: str = "gbdt_prediction.cpp"
+    convert_model_language: str = ""
+
+    # distributed (reference NetworkConfig -> JAX mesh knobs)
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_file: str = ""
+    # TPU additions: how many mesh devices to use per axis; 0 = all available
+    mesh_devices: int = 0
+
+    # compute backend knobs (TPU analogue of gpu_* params)
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    hist_dtype: str = "float32"    # accumulator dtype for histograms
+    use_pallas: bool = False       # pallas kernel on TPU; XLA fallback otherwise
+    rows_per_chunk: int = 0        # 0 = auto
+
+    # file-task fields (CLI)
+    data: str = ""
+    valid_data: List[str] = dataclasses.field(default_factory=list)
+    config_file: str = ""
+
+    def copy(self) -> "Config":
+        return dataclasses.replace(self)
+
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(Config)}
+_LIST_FIELDS = {"metric", "ndcg_eval_at", "valid_data", "label_gain"}
+_BOOL_TRUE = {"true", "1", "yes", "on", "+"}
+_BOOL_FALSE = {"false", "0", "no", "off", "-"}
+
+
+def _parse_value(name: str, value: Any) -> Any:
+    """Coerce a raw (possibly string) value to the field's declared type."""
+    ftype = str(_FIELD_TYPES[name])
+    if name in _LIST_FIELDS:
+        if value is None:
+            return None
+        if isinstance(value, str):
+            parts = [p for p in value.replace(",", " ").split() if p]
+        elif isinstance(value, (list, tuple)):
+            parts = list(value)
+        else:
+            parts = [value]
+        if name == "ndcg_eval_at":
+            return [int(p) for p in parts]
+        if name == "label_gain":
+            return [float(p) for p in parts]
+        return [str(p) for p in parts]
+    if "bool" in ftype:
+        if isinstance(value, bool):
+            return value
+        s = str(value).strip().lower()
+        if s in _BOOL_TRUE:
+            return True
+        if s in _BOOL_FALSE:
+            return False
+        raise ValueError(f"cannot parse bool parameter {name}={value!r}")
+    if "int" in ftype:
+        return int(float(value)) if isinstance(value, str) else int(value)
+    if "float" in ftype:
+        return float(value)
+    return str(value)
+
+
+def canonicalize_params(params: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Alias-resolve a raw param dict; reject unknown keys (config.h:478-481).
+
+    Explicit canonical keys win over aliased ones, mirroring the reference
+    (aliases only fill in missing canonical entries).
+    """
+    params = dict(params or {})
+    out: Dict[str, Any] = {}
+    aliased: Dict[str, Any] = {}
+    for key, value in params.items():
+        k = key.strip().lower()
+        if k in PARAM_ALIASES:
+            aliased[PARAM_ALIASES[k]] = value
+        elif k in _FIELD_TYPES:
+            out[k] = value
+        elif k in ("objective_seed", "saved_feature_importance_type"):
+            continue  # tolerated no-ops
+        else:
+            raise ValueError(f"Unknown parameter: {key}")
+    for k, v in aliased.items():
+        out.setdefault(k, v)
+    return out
+
+
+def config_from_params(params: Optional[Dict[str, Any]] = None,
+                       base: Optional[Config] = None) -> Config:
+    cfg = (base.copy() if base is not None else Config())
+    for k, v in canonicalize_params(params).items():
+        setattr(cfg, k, _parse_value(k, v))
+    check_param_conflicts(cfg)
+    return cfg
+
+
+def check_param_conflicts(cfg: Config) -> None:
+    """Cross-field checks, following src/io/config.cpp:188-240."""
+    if cfg.num_class <= 0:
+        log.fatal("num_class must be positive")
+    is_multiclass = cfg.objective in ("multiclass", "multiclassova", "softmax",
+                                      "multiclass_ova", "ova", "ovr")
+    if is_multiclass and cfg.num_class <= 1:
+        log.fatal("Number of classes should be specified and greater than 1 for multiclass training")
+    if not is_multiclass and cfg.num_class != 1:
+        log.fatal("Number of classes must be 1 for non-multiclass training")
+    if cfg.tree_learner not in ("serial", "feature", "data", "voting"):
+        log.fatal("Unknown tree learner type %s", cfg.tree_learner)
+    if cfg.boosting_type not in ("gbdt", "gbrt", "dart", "goss", "rf", "random_forest"):
+        log.fatal("Unknown boosting type %s", cfg.boosting_type)
+    if cfg.boosting_type in ("rf", "random_forest"):
+        if not (cfg.bagging_freq > 0 and 0.0 < cfg.bagging_fraction < 1.0):
+            log.fatal("Random forest needs bagging (bagging_freq > 0 and 0 < bagging_fraction < 1)")
+    if cfg.max_bin > 65535:
+        log.fatal("max_bin too large (must fit uint16)")
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """key=value config file, '#' comments (application.cpp:48-104)."""
+    params: Dict[str, str] = {}
+    with open(path, "r") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            params[k.strip()] = v.strip()
+    return params

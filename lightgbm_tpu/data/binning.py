@@ -1,0 +1,315 @@
+"""Feature binning (quantization) on the host.
+
+Re-implements the reference BinMapper semantics (``src/io/bin.cpp:72-344``,
+``include/LightGBM/bin.h:60-208,451-483``) in vectorized numpy:
+
+* ``greedy_find_bin``     — equal-count greedy bin boundaries (bin.cpp:72-141)
+* ``find_bin_zero_as_missing`` — split around the zero range (bin.cpp:143-191)
+* ``BinMapper.fit``       — missing-type resolution, categorical mapping,
+                            trivial-feature detection (bin.cpp:193-344)
+* ``BinMapper.value_to_bin`` — vectorized binary-search binning (bin.h:451-483)
+
+Bins are dense: every feature maps to ``[0, num_bin)`` with the NaN bin (if
+``missing_type == NAN``) at index ``num_bin - 1``.  There is no sparse/ordered
+bin variant — the TPU data layout is a dense ``[num_rows, num_features]``
+uint8/uint16 matrix (the reference's own GPU recipe: ``sparse_threshold=1``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils import log
+
+# |value| <= this is treated as "zero" for MissingType.ZERO (reference kZeroAsMissingValueRange)
+ZERO_AS_MISSING_RANGE = 1e-35
+K_EPSILON = 1e-15  # reference kEpsilon used in hessian guards
+
+# MissingType encoding matches the reference decision_type bits ((dt >> 2) & 3)
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+BIN_TYPE_NUMERICAL = 0
+BIN_TYPE_CATEGORICAL = 1
+
+
+def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                    max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Greedy equal-count bin boundary search (bin.cpp:72-141 semantics)."""
+    num_distinct = len(distinct_values)
+    bounds: List[float] = []
+    if max_bin <= 0:
+        return [np.inf]
+    if num_distinct <= max_bin:
+        cur = 0
+        for i in range(num_distinct - 1):
+            cur += int(counts[i])
+            if cur >= min_data_in_bin:
+                bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                cur = 0
+        bounds.append(np.inf)
+        return bounds
+    # more distinct values than bins: greedy mean-size packing with
+    # "big count" values pinned to their own bin
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest_sample_cnt = total_cnt - int(counts[is_big].sum())
+    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    upper = np.full(max_bin, np.inf)
+    lower = np.full(max_bin, np.inf)
+    bin_cnt = 0
+    lower[0] = distinct_values[0]
+    cur = 0
+    for i in range(num_distinct - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur += int(counts[i])
+        if (is_big[i] or cur >= mean_bin_size or
+                (is_big[i + 1] and cur >= max(1.0, mean_bin_size * 0.5))):
+            upper[bin_cnt] = distinct_values[i]
+            bin_cnt += 1
+            lower[bin_cnt] = distinct_values[i + 1]
+            if bin_cnt >= max_bin - 1:
+                break
+            cur = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    bin_cnt += 1
+    bounds = [(upper[i] + lower[i + 1]) / 2.0 for i in range(bin_cnt - 1)]
+    bounds.append(np.inf)
+    return bounds
+
+
+def find_bin_zero_as_missing(distinct_values: np.ndarray, counts: np.ndarray,
+                             max_bin: int, total_sample_cnt: int,
+                             min_data_in_bin: int) -> List[float]:
+    """Bin boundaries with the zero range isolated (bin.cpp:143-191 semantics).
+
+    Negative values and positive values are binned independently with the
+    near-zero range ``(-eps, eps]`` reserved as its own bin boundary pair, so
+    zero always lands in a dedicated bin.
+    """
+    zero_l, zero_r = -ZERO_AS_MISSING_RANGE, ZERO_AS_MISSING_RANGE
+    left_mask = distinct_values <= zero_l
+    right_mask = distinct_values > zero_r
+    left_cnt_data = int(counts[left_mask].sum())
+    right_cnt_data = int(counts[right_mask].sum())
+    cnt_missing = total_sample_cnt - left_cnt_data - right_cnt_data
+
+    bounds: List[float] = []
+    left_cnt = int(left_mask.sum())
+    if left_cnt > 0:
+        denom = max(total_sample_cnt - cnt_missing, 1)
+        left_max_bin = int(left_cnt_data / denom * (max_bin - 1))
+        lb = greedy_find_bin(distinct_values[:left_cnt], counts[:left_cnt],
+                             left_max_bin, left_cnt_data, min_data_in_bin)
+        lb[-1] = zero_l
+        bounds.extend(lb)
+
+    if right_cnt_data > 0:
+        right_start = int(np.argmax(right_mask))
+        right_max_bin = max_bin - 1 - len(bounds)
+        rb = greedy_find_bin(distinct_values[right_start:], counts[right_start:],
+                             right_max_bin, right_cnt_data, min_data_in_bin)
+        bounds.append(zero_r)
+        bounds.extend(rb)
+    else:
+        bounds.append(np.inf)
+    return bounds
+
+
+@dataclasses.dataclass
+class BinMapper:
+    """Per-feature value→bin mapping (bin.h:60-208 analogue)."""
+
+    num_bin: int = 1
+    bin_type: int = BIN_TYPE_NUMERICAL
+    missing_type: int = MISSING_NONE
+    is_trivial: bool = True
+    bin_upper_bound: Optional[np.ndarray] = None     # numerical
+    categorical_2_bin: Optional[Dict[int, int]] = None
+    bin_2_categorical: Optional[List[int]] = None
+    min_val: float = 0.0
+    max_val: float = 0.0
+    default_bin: int = 0   # bin of value 0.0 — the "most frequent" bin for sparse data
+
+    @staticmethod
+    def fit(values: np.ndarray, total_sample_cnt: int, max_bin: int,
+            min_data_in_bin: int, min_split_data: int,
+            bin_type: int = BIN_TYPE_NUMERICAL,
+            use_missing: bool = True, zero_as_missing: bool = False) -> "BinMapper":
+        """Build a BinMapper from sampled values (bin.cpp:193-344 semantics).
+
+        ``values`` are the sampled *non-zero-filtered* values; rows absent from
+        the sample are implicitly zero (``total_sample_cnt - len(values)``),
+        matching the reference's sparse sampling convention.
+        """
+        m = BinMapper()
+        values = np.asarray(values, dtype=np.float64)
+        nan_mask = np.isnan(values)
+        na_cnt = int(nan_mask.sum())
+        vals = values[~nan_mask]
+
+        if not use_missing:
+            m.missing_type = MISSING_NONE
+            na_cnt = 0
+        elif zero_as_missing:
+            m.missing_type = MISSING_ZERO
+        else:
+            m.missing_type = MISSING_NAN if na_cnt > 0 else MISSING_NONE
+
+        # rows absent from the sample and (unless NaN-tracked) NaN rows count as zero
+        zero_cnt = total_sample_cnt - len(vals)
+        if m.missing_type == MISSING_NAN:
+            zero_cnt -= na_cnt
+        zero_cnt = max(int(zero_cnt), 0)
+        # distinct values with zero injected at its sorted position carrying zero_cnt
+        vals = np.sort(vals)
+        distinct, counts = (np.unique(vals, return_counts=True)
+                            if len(vals) else (np.empty(0), np.empty(0, dtype=np.int64)))
+        if zero_cnt > 0 or len(distinct) == 0:
+            if len(distinct) == 0 or 0.0 not in distinct:
+                pos = int(np.searchsorted(distinct, 0.0))
+                distinct = np.insert(distinct, pos, 0.0)
+                counts = np.insert(counts, pos, zero_cnt)
+            else:
+                counts = counts.copy()
+                counts[np.searchsorted(distinct, 0.0)] += zero_cnt
+        distinct = distinct.astype(np.float64)
+        counts = counts.astype(np.int64)
+        m.min_val = float(distinct[0]) if len(distinct) else 0.0
+        m.max_val = float(distinct[-1]) if len(distinct) else 0.0
+
+        num_distinct = len(distinct)
+        if num_distinct + (1 if na_cnt > 0 else 0) <= 2:
+            bin_type = BIN_TYPE_NUMERICAL
+        m.bin_type = bin_type
+
+        if bin_type == BIN_TYPE_NUMERICAL:
+            if m.missing_type == MISSING_ZERO:
+                bounds = find_bin_zero_as_missing(distinct, counts, max_bin,
+                                                  total_sample_cnt, min_data_in_bin)
+                if len(bounds) == 2:
+                    m.missing_type = MISSING_NONE
+            elif m.missing_type == MISSING_NONE:
+                bounds = find_bin_zero_as_missing(distinct, counts, max_bin,
+                                                  total_sample_cnt, min_data_in_bin)
+            else:  # NAN: reserve last bin for NaN
+                bounds = find_bin_zero_as_missing(distinct, counts, max_bin - 1,
+                                                  total_sample_cnt - na_cnt, min_data_in_bin)
+                bounds.append(np.nan)
+            m.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+            m.num_bin = len(bounds)
+            # count per bin for the trivial/filter checks
+            cnt_in_bin = np.zeros(m.num_bin, dtype=np.int64)
+            effective_bins = m.num_bin - (1 if m.missing_type == MISSING_NAN else 0)
+            if num_distinct:
+                # value goes to the first bin whose upper bound is >= value
+                idx = np.searchsorted(m.bin_upper_bound[:effective_bins - 1],
+                                      distinct, side="left")
+                np.add.at(cnt_in_bin, idx, counts)
+            if m.missing_type == MISSING_NAN:
+                cnt_in_bin[m.num_bin - 1] = na_cnt
+            m.default_bin = int(m.value_to_bin_scalar(0.0))
+        else:
+            # categorical: ints sorted by count desc, keep top until 99% coverage
+            ints = distinct.astype(np.int64)
+            agg: Dict[int, int] = {}
+            for v, c in zip(ints, counts):
+                agg[int(v)] = agg.get(int(v), 0) + int(c)
+            if any(k < 0 for k in agg):
+                log.fatal("Cannot use negative numbers in categorical features")
+            items = sorted(agg.items(), key=lambda kv: -kv[1])
+            # avoid first bin being category 0 (reference bin.cpp:305-308)
+            if len(items) > 1 and items[0][0] == 0:
+                items[0], items[1] = items[1], items[0]
+            cut_cnt = int((total_sample_cnt - na_cnt) * 0.99)
+            m.bin_2_categorical = []
+            m.categorical_2_bin = {}
+            used_cnt = 0
+            nb = 0
+            mb = min(len(items), max_bin)
+            while (used_cnt < cut_cnt or nb < mb) and nb < len(items):
+                cat, c = items[nb]
+                m.bin_2_categorical.append(cat)
+                m.categorical_2_bin[cat] = nb
+                used_cnt += c
+                nb += 1
+            m.num_bin = nb
+            if nb == len(items) and na_cnt == 0:
+                m.missing_type = MISSING_NONE
+            elif na_cnt == 0:
+                m.missing_type = MISSING_ZERO
+            else:
+                m.missing_type = MISSING_NAN
+            cnt_in_bin = np.asarray([c for _, c in items[:nb]], dtype=np.int64)
+            if nb > 0:
+                cnt_in_bin[-1] += total_sample_cnt - used_cnt
+            m.default_bin = 0
+
+        m.is_trivial = m.num_bin <= 1
+        if not m.is_trivial and _need_filter(cnt_in_bin, total_sample_cnt,
+                                             min_split_data, m.bin_type):
+            m.is_trivial = True
+        return m
+
+    # -- binning -----------------------------------------------------------
+
+    def value_to_bin_scalar(self, value: float) -> int:
+        return int(self.value_to_bin(np.asarray([value]))[0])
+
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized ValueToBin (bin.h:451-483 semantics)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BIN_TYPE_NUMERICAL:
+            nan_mask = np.isnan(values)
+            v = np.where(nan_mask, 0.0, values)
+            n_search = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+            # first bin whose upper bound >= value  (upper bounds strictly increasing)
+            bins = np.searchsorted(self.bin_upper_bound[:n_search - 1], v, side="left")
+            if self.missing_type == MISSING_NAN:
+                bins = np.where(nan_mask, self.num_bin - 1, bins)
+            return bins.astype(np.int32)
+        out = np.full(values.shape, self.num_bin - 1, dtype=np.int32)
+        int_vals = values.astype(np.int64, copy=False)
+        nan_mask = np.isnan(values)
+        for i, v in enumerate(int_vals.ravel()):
+            if not nan_mask.ravel()[i] and int(v) in self.categorical_2_bin:
+                out.ravel()[i] = self.categorical_2_bin[int(v)]
+        return out
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Representative real threshold for a bin (used in the model file)."""
+        if self.bin_type == BIN_TYPE_CATEGORICAL:
+            return float(self.bin_2_categorical[bin_idx])
+        return float(self.bin_upper_bound[bin_idx])
+
+    def feature_info_str(self) -> str:
+        """Model-file feature_infos token (gbdt.cpp SaveModelToString)."""
+        if self.is_trivial:
+            return "none"
+        if self.bin_type == BIN_TYPE_CATEGORICAL:
+            return ":".join(str(c) for c in sorted(self.bin_2_categorical))
+        return f"[{self.min_val:g}:{self.max_val:g}]"
+
+
+def _need_filter(cnt_in_bin: np.ndarray, total_cnt: int, filter_cnt: int,
+                 bin_type: int) -> bool:
+    """True if no split of this feature can satisfy min_split_data (bin.cpp:48-70)."""
+    if bin_type == BIN_TYPE_NUMERICAL:
+        left = np.cumsum(cnt_in_bin[:-1])
+        ok = (left >= filter_cnt) & (total_cnt - left >= filter_cnt)
+        return not bool(ok.any())
+    if len(cnt_in_bin) <= 2:
+        for c in cnt_in_bin[:-1]:
+            if c >= filter_cnt and total_cnt - c >= filter_cnt:
+                return False
+        return True
+    return False

@@ -1,0 +1,148 @@
+"""Binned dataset container — the TPU-native analogue of ``Dataset``.
+
+The reference (``include/LightGBM/dataset.h:280-570``, ``src/io/dataset.cpp``)
+stores features as per-group virtual ``Bin`` columns (dense / sparse /
+4-bit).  On TPU we keep one dense row-major matrix of bin indices
+(uint8 when every feature has <= 256 bins, else uint16) that is uploaded
+once to HBM — the layout the reference itself uses for its GPU learner
+(``GPU-Performance.md`` recipe: ``sparse_threshold=1`` densifies everything).
+
+Construction = sample rows (``bin_construct_sample_cnt``), fit a
+:class:`~lightgbm_tpu.data.binning.BinMapper` per feature, then vectorized
+``value_to_bin`` over every column.  Valid datasets are aligned to their
+training dataset's bin mappers (reference ``create_valid`` convention).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from ..utils.random import make_rng, sample_k
+from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
+                      MISSING_NAN, MISSING_NONE, MISSING_ZERO)
+from .metadata import Metadata
+
+
+class TrainingData:
+    """Fully constructed binned dataset (host side)."""
+
+    def __init__(self):
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.bin_mappers: List[BinMapper] = []
+        self.used_features: List[int] = []         # original feature index per used column
+        self.binned: Optional[np.ndarray] = None   # [N, F_used] uint8/uint16
+        self.metadata: Metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.reference: Optional["TrainingData"] = None
+
+    # -- feature meta arrays consumed by the jitted grower --------------------
+
+    @property
+    def num_used_features(self) -> int:
+        return len(self.used_features)
+
+    def feature_meta(self) -> Dict[str, np.ndarray]:
+        mappers = [self.bin_mappers[i] for i in self.used_features]
+        return {
+            "num_bin": np.asarray([m.num_bin for m in mappers], dtype=np.int32),
+            "missing_type": np.asarray([m.missing_type for m in mappers], dtype=np.int32),
+            "default_bin": np.asarray([m.default_bin for m in mappers], dtype=np.int32),
+            "is_categorical": np.asarray(
+                [m.bin_type == BIN_TYPE_CATEGORICAL for m in mappers], dtype=bool),
+        }
+
+    def max_num_bin(self) -> int:
+        if not self.used_features:
+            return 1
+        return max(self.bin_mappers[i].num_bin for i in self.used_features)
+
+
+def construct(data: np.ndarray,
+              config: Config,
+              label: Optional[np.ndarray] = None,
+              weight: Optional[np.ndarray] = None,
+              group: Optional[np.ndarray] = None,
+              init_score: Optional[np.ndarray] = None,
+              feature_names: Optional[Sequence[str]] = None,
+              categorical_features: Optional[Sequence[int]] = None,
+              reference: Optional[TrainingData] = None) -> TrainingData:
+    """Build a TrainingData from a raw feature matrix.
+
+    Follows ``DatasetLoader::CostructFromSampleData`` (dataset_loader.cpp:482+):
+    sample up to ``bin_construct_sample_cnt`` rows, fit per-feature bin mappers
+    (in one shot — no two-round streaming needed since the matrix is already
+    in memory), then bin every column.
+    """
+    data = np.asarray(data)
+    if data.ndim != 2:
+        log.fatal("Training data must be 2-dimensional")
+    num_data, num_features = data.shape
+    ds = TrainingData()
+    ds.num_data = num_data
+    ds.num_total_features = num_features
+    ds.feature_names = (list(feature_names) if feature_names
+                        else [f"Column_{i}" for i in range(num_features)])
+    cat_set = set(int(c) for c in (categorical_features or []))
+
+    if reference is not None:
+        # valid set aligned to training bin mappers (basic.py reference semantics)
+        ds.reference = reference
+        ds.bin_mappers = reference.bin_mappers
+        ds.used_features = reference.used_features
+        ds.feature_names = reference.feature_names
+        if num_features != reference.num_total_features:
+            log.fatal("Validation data has %d features, training data has %d",
+                      num_features, reference.num_total_features)
+    else:
+        sample_cnt = min(config.bin_construct_sample_cnt, num_data)
+        if sample_cnt < num_data:
+            rng = make_rng(config.data_random_seed)
+            sample_idx = sample_k(rng, num_data, sample_cnt)
+            sample = np.asarray(data[sample_idx], dtype=np.float64)
+        else:
+            sample = np.asarray(data, dtype=np.float64)
+        for j in range(num_features):
+            col = sample[:, j]
+            # sparse convention: pass non-zero values; zeros implied by total count
+            nz = col[(col != 0) | np.isnan(col)]
+            bin_type = BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL
+            mapper = BinMapper.fit(nz, total_sample_cnt=len(col),
+                                   max_bin=config.max_bin,
+                                   min_data_in_bin=config.min_data_in_bin,
+                                   min_split_data=_filter_cnt(
+                                       config, len(sample), num_data),
+                                   bin_type=bin_type,
+                                   use_missing=config.use_missing,
+                                   zero_as_missing=config.zero_as_missing)
+            ds.bin_mappers.append(mapper)
+        ds.used_features = [j for j, m in enumerate(ds.bin_mappers) if not m.is_trivial]
+        if not ds.used_features:
+            log.fatal("Cannot construct Dataset: all features are trivial (constant)")
+
+    # bin all columns
+    dtype = np.uint8 if ds.max_num_bin() <= 256 else np.uint16
+    binned = np.empty((num_data, len(ds.used_features)), dtype=dtype)
+    for out_j, j in enumerate(ds.used_features):
+        binned[:, out_j] = ds.bin_mappers[j].value_to_bin(
+            np.asarray(data[:, j], dtype=np.float64)).astype(dtype)
+    ds.binned = binned
+
+    ds.metadata = Metadata(num_data)
+    if label is not None:
+        ds.metadata.set_label(label)
+    else:
+        ds.metadata.set_label(np.zeros(num_data, dtype=np.float32))
+    ds.metadata.set_weight(weight)
+    ds.metadata.set_query(group)
+    ds.metadata.set_init_score(init_score)
+    return ds
+
+
+def _filter_cnt(config: Config, sample_cnt: int, num_data: int) -> int:
+    """min_split_data for the trivial-feature pre-filter, scaled to the
+    sample size (dataset_loader.cpp:495-496 semantics)."""
+    return int(config.min_data_in_leaf * sample_cnt / max(num_data, 1))

@@ -1,0 +1,85 @@
+"""Per-dataset metadata: labels, weights, query boundaries, init scores.
+
+Equivalent of the reference ``Metadata`` (``include/LightGBM/dataset.h:36-248``,
+``src/io/metadata.cpp``): owns label/weight/group/init-score vectors and loads
+the ``.weight`` / ``.query`` / ``.init`` side files that accompany a data file.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..utils import log
+
+
+class Metadata:
+    def __init__(self, num_data: int = 0):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None        # [N] f32
+        self.weight: Optional[np.ndarray] = None       # [N] f32 or None
+        self.query_boundaries: Optional[np.ndarray] = None  # [num_queries+1] i32
+        self.init_score: Optional[np.ndarray] = None   # [N * num_class] f64 or None
+
+    # -- setters (mirror Metadata::SetLabel/SetWeights/SetQuery/SetInitScore) --
+
+    def set_label(self, label: np.ndarray) -> None:
+        label = np.asarray(label, dtype=np.float32).ravel()
+        if self.num_data and len(label) != self.num_data:
+            log.fatal("Length of label (%d) != num_data (%d)", len(label), self.num_data)
+        self.num_data = len(label)
+        self.label = label
+
+    def set_weight(self, weight: Optional[np.ndarray]) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float32).ravel()
+        if self.num_data and len(weight) != self.num_data:
+            log.fatal("Length of weight (%d) != num_data (%d)", len(weight), self.num_data)
+        self.weight = weight
+
+    def set_query(self, group: Optional[np.ndarray]) -> None:
+        """``group`` is per-query sizes (Python API convention); stored as boundaries."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).ravel()
+        bounds = np.concatenate([[0], np.cumsum(group)]).astype(np.int32)
+        if self.num_data and bounds[-1] != self.num_data:
+            log.fatal("Sum of query counts (%d) != num_data (%d)", int(bounds[-1]), self.num_data)
+        self.query_boundaries = bounds
+
+    def set_init_score(self, init_score: Optional[np.ndarray]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64).ravel()
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+    def query_ids(self) -> Optional[np.ndarray]:
+        """Per-row query index [N] (derived; used by ranking objectives/metrics)."""
+        if self.query_boundaries is None:
+            return None
+        sizes = np.diff(self.query_boundaries)
+        return np.repeat(np.arange(len(sizes), dtype=np.int32), sizes)
+
+    # -- side files (metadata.cpp LoadWeights/LoadQueryBoundaries/LoadInitialScore) --
+
+    def load_side_files(self, data_path: str) -> None:
+        wpath = data_path + ".weight"
+        if os.path.exists(wpath):
+            self.set_weight(np.loadtxt(wpath, dtype=np.float64).ravel())
+            log.info("Loading weights from %s", wpath)
+        qpath = data_path + ".query"
+        if os.path.exists(qpath):
+            self.set_query(np.loadtxt(qpath, dtype=np.int64).ravel())
+            log.info("Loading query boundaries from %s", qpath)
+        ipath = data_path + ".init"
+        if os.path.exists(ipath):
+            self.set_init_score(np.loadtxt(ipath, dtype=np.float64).ravel())
+            log.info("Loading initial scores from %s", ipath)

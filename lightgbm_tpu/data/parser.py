@@ -1,0 +1,117 @@
+"""Text data parsers: CSV / TSV / LibSVM with format auto-detection.
+
+Mirrors the reference parser surface (``src/io/parser.{hpp,cpp}``): the format
+is sniffed from the first lines (``CreateParser``), labels sit in a
+configurable column, LibSVM rows are ``label idx:val ...`` sparse pairs.
+Implemented with numpy batch parsing rather than per-line virtual calls.
+"""
+from __future__ import annotations
+
+import io
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+
+def _sniff_format(lines: List[str]) -> Tuple[str, int]:
+    """Return (format, num_columns). format in {csv, tsv, libsvm}."""
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        tokens_tab = line.split("\t")
+        tokens_comma = line.split(",")
+        tokens_space = line.split()
+        if any(":" in t for t in tokens_space[1:]):
+            return "libsvm", 0
+        if len(tokens_tab) > 1:
+            return "tsv", len(tokens_tab)
+        if len(tokens_comma) > 1:
+            return "csv", len(tokens_comma)
+        if len(tokens_space) > 1:
+            return "tsv", len(tokens_space)  # space-separated handled like TSV
+    return "csv", 1
+
+
+def load_text_file(path: str, has_header: bool = False,
+                   label_idx: int = 0) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
+    """Parse a data file into (features [N, F] float64, labels [N], feature_names).
+
+    Missing values (empty CSV cells, "na"/"nan") become NaN.  LibSVM zero
+    default is 0.0 as in the reference.
+    """
+    with open(path, "r") as f:
+        head = []
+        for _ in range(32):
+            line = f.readline()
+            if not line:
+                break
+            head.append(line)
+    if not head:
+        log.fatal("Data file %s is empty", path)
+    start = 1 if has_header else 0
+    fmt, _ = _sniff_format(head[start:] or head)
+
+    header_names: Optional[List[str]] = None
+    if fmt == "libsvm":
+        return _load_libsvm(path, has_header, label_idx) + (None,)
+
+    delim = "," if fmt == "csv" else None  # None -> any whitespace incl. tab
+    if has_header:
+        sep = "," if fmt == "csv" else "\t"
+        header_names = [t.strip() for t in head[0].strip().split(sep)]
+
+    def conv(text: str) -> np.ndarray:
+        return np.genfromtxt(io.StringIO(text), delimiter=delim,
+                             skip_header=start, dtype=np.float64,
+                             missing_values=["", "na", "nan", "NA", "NaN", "null"],
+                             filling_values=np.nan)
+
+    with open(path, "r") as f:
+        mat = conv(f.read())
+    if mat.ndim == 1:
+        mat = mat.reshape(-1, 1) if mat.size else mat.reshape(0, 1)
+    if label_idx >= 0:
+        labels = mat[:, label_idx].astype(np.float32)
+        features = np.delete(mat, label_idx, axis=1)
+        if header_names is not None:
+            header_names = [h for i, h in enumerate(header_names) if i != label_idx]
+    else:
+        labels = np.zeros(mat.shape[0], dtype=np.float32)
+        features = mat
+    return features, labels, header_names
+
+
+def _load_libsvm(path: str, has_header: bool, label_idx: int) -> Tuple[np.ndarray, np.ndarray]:
+    rows: List[List[Tuple[int, float]]] = []
+    labels: List[float] = []
+    max_idx = -1
+    with open(path, "r") as f:
+        if has_header:
+            f.readline()
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            toks = line.split()
+            if label_idx >= 0:
+                labels.append(float(toks[0]))
+                toks = toks[1:]
+            else:
+                labels.append(0.0)
+            row = []
+            for t in toks:
+                if ":" not in t:
+                    continue
+                i, v = t.split(":", 1)
+                i = int(i)
+                row.append((i, float(v)))
+                max_idx = max(max_idx, i)
+            rows.append(row)
+    mat = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+    for r, row in enumerate(rows):
+        for i, v in row:
+            mat[r, i] = v
+    return mat, np.asarray(labels, dtype=np.float32)

@@ -1,0 +1,261 @@
+"""Training entry points ``train`` and ``cv``.
+
+Mirrors ``python-package/lightgbm/engine.py`` (train :18-229, cv :230-460):
+callback-driven boosting loop, early stopping, evaluation recording,
+stratified / grouped cross-validation folds.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import canonicalize_params
+from .utils import log
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj: Optional[Callable] = None, feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          feature_name: Union[str, List[str]] = "auto",
+          categorical_feature: Union[str, List] = "auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None,
+          verbose_eval: Union[bool, int] = True,
+          keep_training_booster: bool = True,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """engine.py:18-229 analogue."""
+    params = canonicalize_params(params)
+    if "num_iterations" in params:
+        num_boost_round = int(params.pop("num_iterations"))
+    if "early_stopping_round" in params and params["early_stopping_round"]:
+        early_stopping_rounds = int(params.pop("early_stopping_round"))
+    if fobj is not None:
+        params.setdefault("objective", "regression")
+
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        # continued training: load old model, use it as init scores
+        prev = init_model if isinstance(init_model, Booster) \
+            else Booster(model_file=str(init_model), params=params)
+        raw = train_set.raw if train_set.raw is not None else train_set.data
+        if raw is None:
+            log.fatal("Continued training requires raw data "
+                      "(set free_raw_data=False)")
+        init_scores = prev.inner.predictor().predict_raw(np.asarray(raw))
+        booster.inner.scores = booster.inner.scores + np.asarray(
+            init_scores, np.float32)
+        booster.inner.num_init_iteration = prev.inner.current_iteration()
+        booster.inner.models = list(prev.inner.models) + booster.inner.models
+        booster.inner.boost_from_average_ = prev.inner.boost_from_average_
+
+    valid_sets = valid_sets or []
+    valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
+    is_valid_contain_train = False
+    train_data_name = "training"
+    for vs, name in zip(valid_sets, valid_names):
+        if vs is train_set:
+            is_valid_contain_train = True
+            train_data_name = name
+            continue
+        booster.add_valid(vs, name)
+
+    cbs = list(callbacks or [])
+    if verbose_eval is True:
+        cbs.append(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval > 0:
+        cbs.append(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.append(callback_mod.early_stopping(early_stopping_rounds,
+                                               bool(verbose_eval)))
+    if evals_result is not None:
+        cbs.append(callback_mod.record_evaluation(evals_result))
+    cbs_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
+    cbs_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
+    cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                        iteration=i, begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=None))
+        finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_sets:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(
+                    (train_data_name, m, v, hib)
+                    for (_, m, v, hib) in booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            for item in (es.best_score or []):
+                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+            break
+        if finished:
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+    return booster
+
+
+CVBooster = collections.namedtuple("CVBooster", ["boosters"])
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params: Dict,
+                  seed: int, stratified: bool, shuffle: bool,
+                  group_info: Optional[np.ndarray]):
+    full_data.construct()
+    num_data = full_data.num_data()
+    rng = np.random.RandomState(seed)
+    if group_info is not None:
+        # group-aware folds: split whole queries
+        group_sizes = np.asarray(group_info, dtype=np.int64)
+        ngroups = len(group_sizes)
+        gidx = np.arange(ngroups)
+        if shuffle:
+            rng.shuffle(gidx)
+        folds_groups = np.array_split(gidx, nfold)
+        bounds = np.concatenate([[0], np.cumsum(group_sizes)])
+        for fg in folds_groups:
+            test_idx = np.concatenate(
+                [np.arange(bounds[g], bounds[g + 1]) for g in fg]) \
+                if len(fg) else np.empty(0, dtype=np.int64)
+            yield np.setdiff1d(np.arange(num_data), test_idx), test_idx, fg
+        return
+    if stratified:
+        label = full_data.get_label().astype(np.int64)
+        folds = [[] for _ in range(nfold)]
+        for cls in np.unique(label):
+            idx = np.nonzero(label == cls)[0]
+            if shuffle:
+                rng.shuffle(idx)
+            for f, part in enumerate(np.array_split(idx, nfold)):
+                folds[f].append(part)
+        for f in range(nfold):
+            test_idx = np.concatenate(folds[f])
+            yield np.setdiff1d(np.arange(num_data), test_idx), test_idx, None
+        return
+    idx = np.arange(num_data)
+    if shuffle:
+        rng.shuffle(idx)
+    for part in np.array_split(idx, nfold):
+        yield np.setdiff1d(np.arange(num_data), part), part, None
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics: Optional[Union[str, List[str]]] = None,
+       fobj=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds: Optional[int] = None,
+       verbose_eval=None, seed: int = 0,
+       callbacks: Optional[List[Callable]] = None,
+       eval_train_metric: bool = False) -> Dict[str, List[float]]:
+    """engine.py:230-460 analogue; returns {metric-mean: [...], metric-stdv: [...]}."""
+    params = canonicalize_params(params)
+    if "num_iterations" in params:
+        num_boost_round = int(params.pop("num_iterations"))
+    if metrics is not None:
+        params["metric"] = metrics
+    if params.get("objective", "").startswith(("binary",)) is False \
+            and params.get("objective") not in ("binary", "multiclass",
+                                                "multiclassova"):
+        stratified = False if params.get("objective") else stratified
+
+    train_set.construct()
+    raw = train_set.raw
+    if raw is None:
+        log.fatal("cv requires raw data (set free_raw_data=False)")
+    label = train_set.get_label()
+    weight = train_set.get_weight()
+    group = train_set.get_group()
+
+    if folds is None:
+        folds = list(_make_n_folds(train_set, nfold, params, seed,
+                                   stratified and group is None, shuffle, group))
+    else:
+        folds = [(tr, te, None) if len(f) == 2 else f
+                 for f in (tuple(f) for f in folds)]
+
+    boosters: List[Booster] = []
+    for train_idx, test_idx, fold_groups in folds:
+        tr = Dataset(raw[train_idx], label=label[train_idx],
+                     weight=None if weight is None else weight[train_idx],
+                     params=dict(params))
+        te_ref = tr.create_valid(
+            raw[test_idx], label=label[test_idx],
+            weight=None if weight is None else weight[test_idx])
+        if group is not None:
+            # recompute per-fold group sizes
+            gsizes = np.asarray(group, dtype=np.int64)
+            gid = np.repeat(np.arange(len(gsizes)), gsizes)
+            tr.group = np.bincount(gid[train_idx])[np.unique(gid[train_idx])]
+            te_ref.group = np.bincount(gid[test_idx])[np.unique(gid[test_idx])]
+        booster = Booster(params=dict(params), train_set=tr)
+        booster.add_valid(te_ref, "valid")
+        boosters.append(booster)
+
+    results: Dict[str, List[float]] = collections.defaultdict(list)
+    es_cb = (callback_mod.early_stopping(early_stopping_rounds, False)
+             if early_stopping_rounds else None)
+    for i in range(num_boost_round):
+        all_evals = []
+        for booster in boosters:
+            booster.update(fobj=fobj)
+            evals = booster.eval_valid(feval)
+            if eval_train_metric:
+                evals = list(booster.eval_train(feval)) + list(evals)
+            all_evals.append(evals)
+        # aggregate across folds
+        agg: Dict[tuple, List[float]] = collections.defaultdict(list)
+        order: List[tuple] = []
+        for evals in all_evals:
+            for name, metric, value, hib in evals:
+                key = (name, metric, hib)
+                if key not in agg:
+                    order.append(key)
+                agg[key].append(value)
+        merged = []
+        for key in order:
+            name, metric, hib = key
+            vals = agg[key]
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results[f"{metric}-mean"].append(mean)
+            results[f"{metric}-stdv"].append(std)
+            merged.append((f"cv_agg {name}", metric, mean, hib, std))
+        if verbose_eval:
+            log.info("[%d]\t%s", i + 1,
+                     "\t".join(f"{m[1]}: {m[2]:g} + {m[4]:g}" for m in merged))
+        if es_cb is not None:
+            try:
+                es_cb(callback_mod.CallbackEnv(
+                    model=CVBooster(boosters), params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=merged))
+            except callback_mod.EarlyStopException as es:
+                for k in results:
+                    results[k] = results[k][:es.best_iteration + 1]
+                break
+    return dict(results)

@@ -1,0 +1,257 @@
+"""Leaf-wise tree growing as a single jitted XLA program.
+
+TPU-native re-design of ``SerialTreeLearner::Train``
+(``src/treelearner/serial_tree_learner.cpp:152-205``):
+
+* the reference's ``DataPartition`` index reordering becomes a static-shape
+  ``row_leaf`` assignment vector (no compaction, no dynamic shapes);
+* per-split histogram work is one masked sweep that produces BOTH children
+  of the split in a single pass (see ``ops.histogram``), replacing the
+  smaller-child + parent-subtraction trick;
+* the split loop is a ``lax.while_loop`` with all per-leaf state in fixed
+  ``[num_leaves]`` arrays, so one compilation serves every tree;
+* distribution hooks in via ``reduce_hist`` (``lax.psum`` over the mesh) —
+  the data-parallel learner's ReduceScatter
+  (``data_parallel_tree_learner.cpp:148-163``) collapses to that one line.
+
+Output is a struct-of-arrays tree (same SoA layout as the reference ``Tree``,
+``include/LightGBM/tree.h:20-370``) plus the final row→leaf map used for the
+O(N) training-score update.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ops.histogram import child_histograms
+from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
+                        best_split, leaf_output)
+
+
+class GrowerConfig(NamedTuple):
+    """Static (compile-time) training params for one tree."""
+    num_leaves: int = 31
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    max_bin: int = 256               # B: histogram width (max over features)
+    hist_method: str = "auto"        # onehot | segsum | pallas | auto
+    rows_per_chunk: int = 16384
+
+    def split_config(self) -> SplitConfig:
+        return SplitConfig(self.lambda_l1, self.lambda_l2, self.min_gain_to_split,
+                           self.min_data_in_leaf, self.min_sum_hessian_in_leaf)
+
+
+class TreeArrays(NamedTuple):
+    """Device-side SoA tree; mirrors the reference Tree fields (tree.h:316-370)."""
+    num_leaves: jnp.ndarray       # scalar i32 (actual leaves grown)
+    split_feature: jnp.ndarray    # [L-1] i32 (inner/used feature index)
+    threshold_bin: jnp.ndarray    # [L-1] i32
+    default_left: jnp.ndarray     # [L-1] bool
+    left_child: jnp.ndarray       # [L-1] i32 (node index, or ~leaf if < 0)
+    right_child: jnp.ndarray      # [L-1] i32
+    split_gain: jnp.ndarray       # [L-1] f32
+    internal_value: jnp.ndarray   # [L-1] f32
+    internal_count: jnp.ndarray   # [L-1] f32
+    leaf_value: jnp.ndarray       # [L] f32 (unshrunk)
+    leaf_count: jnp.ndarray       # [L] f32
+    leaf_parent: jnp.ndarray      # [L] i32
+    leaf_depth: jnp.ndarray       # [L] i32
+
+
+class FeatureMeta(NamedTuple):
+    """Per-used-feature static metadata as device arrays."""
+    num_bin: jnp.ndarray       # [F] i32
+    missing_type: jnp.ndarray  # [F] i32 (0 none / 1 zero / 2 nan)
+    default_bin: jnp.ndarray   # [F] i32
+    is_categorical: jnp.ndarray  # [F] bool
+
+
+class _LoopState(NamedTuple):
+    step: jnp.ndarray
+    row_leaf: jnp.ndarray
+    splits: SplitResult          # per-leaf SoA, each field [L]
+    tree: TreeArrays
+
+
+def _set(arr, idx, value):
+    return arr.at[idx].set(value)
+
+
+def _update_splits(splits: SplitResult, idx, res: SplitResult) -> SplitResult:
+    return SplitResult(*[_set(a, idx, v) for a, v in zip(splits, res)])
+
+
+def _depth_gate(res: SplitResult, leaf_depth, max_depth) -> SplitResult:
+    """A leaf at depth d (root = 0) may be split iff d < max_depth
+    (serial_tree_learner.cpp:326+ BeforeFindBestSplit guard)."""
+    if max_depth <= 0:
+        return res
+    ok = leaf_depth < max_depth
+    return res._replace(found=res.found & ok,
+                        gain=jnp.where(ok, res.gain, -jnp.inf))
+
+
+def make_grower(cfg: GrowerConfig,
+                reduce_hist: Optional[Callable] = None,
+                local_count: Optional[Callable] = None) -> Callable:
+    """Build the jittable ``grow_tree`` function.
+
+    ``reduce_hist(hist)`` — identity for single device; ``lax.psum`` over the
+    data axis inside ``shard_map`` for the data-parallel learner.
+    ``local_count`` — same idea for scalar row statistics.
+    """
+    L = cfg.num_leaves
+    B = cfg.max_bin
+    scfg = cfg.split_config()
+    if reduce_hist is None:
+        reduce_hist = lambda x: x
+    if local_count is None:
+        local_count = lambda x: x
+
+    def hist_fn(bins, seg, gw, hw, cw):
+        h = child_histograms(bins, seg, gw, hw, cw, B,
+                             method=cfg.hist_method,
+                             rows_per_chunk=cfg.rows_per_chunk)
+        return reduce_hist(h)
+
+    def find(hist_child, pg, ph, pc, meta: FeatureMeta, feat_valid):
+        return best_split(hist_child, pg, ph, pc, meta.num_bin,
+                          meta.missing_type, meta.default_bin, feat_valid, scfg)
+
+    def grow_tree(bins: jnp.ndarray,        # [N, F] uint8/uint16/int32
+                  gw: jnp.ndarray,          # [N] f32   grad * bag_weight
+                  hw: jnp.ndarray,          # [N] f32   hess * bag_weight
+                  cw: jnp.ndarray,          # [N] f32   bag weight (0/1 or frac)
+                  meta: FeatureMeta,
+                  feat_valid: jnp.ndarray   # [F] bool
+                  ):
+        n, f = bins.shape
+        dtype = gw.dtype
+
+        root_g = local_count(jnp.sum(gw))
+        root_h = local_count(jnp.sum(hw))
+        root_c = local_count(jnp.sum(cw))
+
+        row_leaf = jnp.zeros((n,), jnp.int32)
+        seg0 = jnp.zeros((n,), jnp.int32)   # all rows in "left" slot -> root hist
+        hist_root = hist_fn(bins, seg0, gw, hw, cw)[0]
+        res_root = find(hist_root, root_g, root_h, root_c, meta, feat_valid)
+        res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
+
+        def blank_res(x):
+            return jnp.zeros((L,) + x.shape, x.dtype)
+
+        splits = SplitResult(*[blank_res(v) for v in res_root])
+        splits = splits._replace(gain=jnp.full((L,), -jnp.inf, res_root.gain.dtype))
+        splits = _update_splits(splits, 0, res_root)
+
+        tree = TreeArrays(
+            num_leaves=jnp.asarray(1, jnp.int32),
+            split_feature=jnp.zeros((L - 1,), jnp.int32),
+            threshold_bin=jnp.zeros((L - 1,), jnp.int32),
+            default_left=jnp.zeros((L - 1,), bool),
+            left_child=jnp.zeros((L - 1,), jnp.int32),
+            right_child=jnp.zeros((L - 1,), jnp.int32),
+            split_gain=jnp.zeros((L - 1,), dtype),
+            internal_value=jnp.zeros((L - 1,), dtype),
+            internal_count=jnp.zeros((L - 1,), dtype),
+            leaf_value=jnp.zeros((L,), dtype),
+            leaf_count=_set(jnp.zeros((L,), dtype), 0, root_c),
+            leaf_parent=jnp.full((L,), -1, jnp.int32),
+            leaf_depth=jnp.zeros((L,), jnp.int32),
+        )
+
+        def cond(state: _LoopState):
+            return ((state.step < L - 1)
+                    & (jnp.max(state.splits.gain) > 0.0))
+
+        def body(state: _LoopState) -> _LoopState:
+            i = state.step
+            splits = state.splits
+            tree = state.tree
+            l = jnp.argmax(splits.gain).astype(jnp.int32)
+            new_leaf = i + 1
+            node = i
+
+            feat = splits.feature[l]
+            thr = splits.threshold[l]
+            dleft = splits.default_left[l]
+
+            # --- partition rows of leaf l (DataPartition::Split analogue) ----
+            binf = lax.dynamic_index_in_dim(bins, feat, axis=1,
+                                            keepdims=False).astype(jnp.int32)
+            mt_f = meta.missing_type[feat]
+            nb_f = meta.num_bin[feat]
+            db_f = meta.default_bin[feat]
+            is_missing = (((mt_f == MISSING_NAN) & (binf == nb_f - 1))
+                          | ((mt_f == MISSING_ZERO) & (binf == db_f)))
+            goes_left = jnp.where(is_missing, dleft, binf <= thr)
+            in_leaf = state.row_leaf == l
+            row_leaf = jnp.where(in_leaf & ~goes_left, new_leaf, state.row_leaf)
+
+            # --- record the node (Tree::Split, tree.h:319-345) ---------------
+            parent_node = tree.leaf_parent[l]
+            pn = jnp.maximum(parent_node, 0)
+            node_iota = jnp.arange(L - 1, dtype=jnp.int32)
+            relink = (parent_node >= 0) & (node_iota == pn)
+            left_child = jnp.where(relink & (tree.left_child == ~l),
+                                   node, tree.left_child)
+            right_child = jnp.where(relink & (tree.right_child == ~l),
+                                    node, tree.right_child)
+            left_child = _set(left_child, node, ~l)
+            right_child = _set(right_child, node, ~new_leaf)
+
+            parent_g = splits.left_sum_g[l] + splits.right_sum_g[l]
+            parent_h = splits.left_sum_h[l] + splits.right_sum_h[l]
+            parent_depth = tree.leaf_depth[l]
+            child_depth = parent_depth + 1
+            tree = tree._replace(
+                num_leaves=new_leaf + 1,
+                split_feature=_set(tree.split_feature, node, feat),
+                threshold_bin=_set(tree.threshold_bin, node, thr),
+                default_left=_set(tree.default_left, node, dleft),
+                left_child=left_child,
+                right_child=right_child,
+                split_gain=_set(tree.split_gain, node, splits.gain[l]),
+                internal_value=_set(tree.internal_value, node,
+                                    leaf_output(parent_g, parent_h,
+                                                cfg.lambda_l1, cfg.lambda_l2)),
+                internal_count=_set(tree.internal_count, node, tree.leaf_count[l]),
+                leaf_value=_set(_set(tree.leaf_value, l, splits.left_output[l]),
+                                new_leaf, splits.right_output[l]),
+                leaf_count=_set(_set(tree.leaf_count, l, splits.left_count[l]),
+                                new_leaf, splits.right_count[l]),
+                leaf_parent=_set(_set(tree.leaf_parent, l, node), new_leaf, node),
+                leaf_depth=_set(_set(tree.leaf_depth, l, child_depth),
+                                new_leaf, child_depth),
+            )
+
+            # --- histograms + best splits for both children in one sweep -----
+            seg = jnp.where(row_leaf == l, 0,
+                            jnp.where(row_leaf == new_leaf, 1, 2))
+            hist2 = hist_fn(bins, seg, gw, hw, cw)
+            res_l = find(hist2[0], splits.left_sum_g[l], splits.left_sum_h[l],
+                         splits.left_count[l], meta, feat_valid)
+            res_r = find(hist2[1], splits.right_sum_g[l], splits.right_sum_h[l],
+                         splits.right_count[l], meta, feat_valid)
+            res_l = _depth_gate(res_l, child_depth, cfg.max_depth)
+            res_r = _depth_gate(res_r, child_depth, cfg.max_depth)
+
+            splits = _update_splits(splits, l, res_l)
+            splits = _update_splits(splits, new_leaf, res_r)
+            return _LoopState(i + 1, row_leaf, splits, tree)
+
+        state = _LoopState(jnp.asarray(0, jnp.int32), row_leaf, splits, tree)
+        state = lax.while_loop(cond, body, state)
+        return state.tree, state.row_leaf
+
+    return grow_tree

@@ -1,0 +1,198 @@
+"""Vectorized best-split search over feature histograms.
+
+Reproduces ``FeatureHistogram::FindBestThresholdNumerical`` /
+``FindBestThresholdSequence`` (``src/treelearner/feature_histogram.hpp:82-418``)
+as one tensor program over all features at once — no per-feature loop:
+
+* two scan directions become two cumulative-sum families over the bin axis;
+* the reference's ``continue``/``break`` constraint guards become masks (all
+  guarded quantities are monotone along the scan, so masking is equivalent);
+* missing-value handling (``MissingType`` none/zero/nan) selects which bins
+  contribute to each side and which thresholds are candidates;
+* tie-breaking matches the reference scan order: smallest feature index wins,
+  then direction -1 (missing defaults left) before +1, then the -1 scan
+  prefers the largest threshold and the +1 scan the smallest.
+
+Gain = ``G(left) + G(right) - G(parent) - min_gain_to_split`` with the L1
+soft-threshold regularizer ``G(s,h) = max(0, |s|-l1)^2 / (h+l2)``
+(``feature_histogram.hpp:255-262``).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+K_EPSILON = 1e-15  # reference kEpsilon
+MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
+
+
+class SplitConfig(NamedTuple):
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+
+
+class SplitResult(NamedTuple):
+    """Best split of one leaf (scalar fields) — analogue of SplitInfo
+    (src/treelearner/split_info.hpp:17-120)."""
+    found: jnp.ndarray        # bool
+    gain: jnp.ndarray         # f32, already reduced by gain_shift; -inf if none
+    feature: jnp.ndarray      # i32 index into used features; -1 if none
+    threshold: jnp.ndarray    # i32 bin threshold (left: bin <= threshold)
+    default_left: jnp.ndarray # bool
+    left_sum_g: jnp.ndarray
+    left_sum_h: jnp.ndarray
+    left_count: jnp.ndarray   # f32 count
+    right_sum_g: jnp.ndarray
+    right_sum_h: jnp.ndarray
+    right_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_output: jnp.ndarray
+
+
+def leaf_split_gain(sum_g, sum_h, l1, l2):
+    """G(s, h) with L1 soft-thresholding (feature_histogram.hpp:255-262)."""
+    reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
+    return reg * reg / (sum_h + l2)
+
+
+def leaf_output(sum_g, sum_h, l1, l2):
+    """Leaf weight -sign(s)*max(0,|s|-l1)/(h+l2) (feature_histogram.hpp:269-274)."""
+    reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
+    return -jnp.sign(sum_g) * reg / (sum_h + l2)
+
+
+def best_split(hist: jnp.ndarray,
+               parent_g: jnp.ndarray, parent_h: jnp.ndarray, parent_c: jnp.ndarray,
+               num_bin: jnp.ndarray, missing_type: jnp.ndarray,
+               default_bin: jnp.ndarray, feat_valid: jnp.ndarray,
+               cfg: SplitConfig) -> SplitResult:
+    """Best numerical split across all features of one leaf.
+
+    hist: [F, B, 3] (sum_g, sum_h, count); num_bin/missing_type/default_bin:
+    [F] i32; feat_valid: [F] bool (feature_fraction & non-trivial &
+    non-categorical).  parent_*: scalars for the leaf.
+    """
+    dtype = hist.dtype
+    f, b, _ = hist.shape
+    g = hist[:, :, 0]
+    h = hist[:, :, 1]
+    c = hist[:, :, 2]
+    bins = lax.broadcasted_iota(jnp.int32, (f, b), 1)
+    nb = num_bin[:, None]
+    mt = missing_type[:, None]
+    db = default_bin[:, None]
+    nan_bin = nb - 1
+
+    l1 = jnp.asarray(cfg.lambda_l1, dtype)
+    l2 = jnp.asarray(cfg.lambda_l2, dtype)
+    min_data = jnp.asarray(cfg.min_data_in_leaf, dtype)
+    min_hess = jnp.asarray(cfg.min_sum_hessian_in_leaf, dtype)
+
+    tot_h = parent_h + 2.0 * K_EPSILON
+    gain_shift = leaf_split_gain(parent_g, tot_h, l1, l2)
+    min_gain_shift = gain_shift + cfg.min_gain_to_split
+
+    two_dir = (nb > 2) & (mt != MISSING_NONE)
+    na_excl = two_dir & (mt == MISSING_NAN)    # dir=-1 keeps NaN bin out of right
+    zero_skip = two_dir & (mt == MISSING_ZERO)
+
+    neg_inf = jnp.asarray(-jnp.inf, dtype)
+
+    def eval_candidates(left_g, left_h, left_c, cand):
+        right_g = parent_g - left_g
+        right_h = tot_h - left_h
+        right_c = parent_c - left_c
+        ok = (cand
+              & (left_c >= min_data) & (right_c >= min_data)
+              & (left_h >= min_hess) & (right_h >= min_hess))
+        gain = (leaf_split_gain(left_g, left_h, l1, l2)
+                + leaf_split_gain(right_g, right_h, l1, l2))
+        ok = ok & (gain > min_gain_shift)
+        return jnp.where(ok, gain, neg_inf), left_g, left_h, left_c
+
+    # ---- dir = -1 : accumulate from the right; missing defaults LEFT --------
+    keep_m1 = ~((zero_skip & (bins == db)) | (na_excl & (bins == nan_bin)))
+    gk = jnp.where(keep_m1, g, 0.0)
+    hk = jnp.where(keep_m1, h, 0.0)
+    ck = jnp.where(keep_m1, c, 0.0)
+    # right side at threshold t = sum of kept bins strictly above t
+    right_g_m1 = jnp.sum(gk, axis=1, keepdims=True) - jnp.cumsum(gk, axis=1)
+    right_h_m1 = (jnp.sum(hk, axis=1, keepdims=True) - jnp.cumsum(hk, axis=1)
+                  + K_EPSILON)
+    right_c_m1 = jnp.sum(ck, axis=1, keepdims=True) - jnp.cumsum(ck, axis=1)
+    left_g_m1 = parent_g - right_g_m1
+    left_h_m1 = tot_h - right_h_m1
+    left_c_m1 = parent_c - right_c_m1
+    cand_m1 = (feat_valid[:, None]
+               & (bins <= nb - 2 - na_excl.astype(jnp.int32))
+               & ~(zero_skip & (bins == db - 1)))
+    gain_m1, lg_m1, lh_m1, lc_m1 = eval_candidates(left_g_m1, left_h_m1,
+                                                   left_c_m1, cand_m1)
+
+    # ---- dir = +1 : accumulate from the left; missing defaults RIGHT --------
+    keep_p1 = ~(zero_skip & (bins == db))
+    gk = jnp.where(keep_p1, g, 0.0)
+    hk = jnp.where(keep_p1, h, 0.0)
+    ck = jnp.where(keep_p1, c, 0.0)
+    left_g_p1 = jnp.cumsum(gk, axis=1)
+    left_h_p1 = jnp.cumsum(hk, axis=1) + K_EPSILON
+    left_c_p1 = jnp.cumsum(ck, axis=1)
+    cand_p1 = (feat_valid[:, None] & two_dir
+               & (bins <= nb - 2)
+               & ~(zero_skip & (bins == db)))
+    gain_p1, lg_p1, lh_p1, lc_p1 = eval_candidates(left_g_p1, left_h_p1,
+                                                   left_c_p1, cand_p1)
+
+    # ---- combine with reference tie-break order -----------------------------
+    # [F, 2B]: dir=-1 flipped (largest threshold first), then dir=+1 ascending
+    def pack(a_m1, a_p1):
+        return jnp.concatenate([jnp.flip(a_m1, axis=1), a_p1], axis=1)
+
+    gains = pack(gain_m1, gain_p1)
+    lg = pack(lg_m1, lg_p1)
+    lh = pack(lh_m1, lh_p1)
+    lc = pack(lc_m1, lc_p1)
+    thr = pack(bins, bins)  # pack() flips the dir=-1 half itself
+    is_m1 = pack(jnp.ones_like(bins, dtype=bool), jnp.zeros_like(bins, dtype=bool))
+
+    flat_gains = gains.reshape(-1)
+    idx = jnp.argmax(flat_gains)
+    best_gain = flat_gains[idx]
+    found = best_gain > neg_inf
+
+    feature = jnp.where(found, (idx // (2 * b)).astype(jnp.int32), -1)
+    threshold = jnp.where(found, thr.reshape(-1)[idx], 0)
+    default_left = jnp.where(found, is_m1.reshape(-1)[idx], True)
+    # 2-bin NaN features always default right (feature_histogram.hpp:97-100)
+    fi = jnp.clip(feature, 0, f - 1)
+    force_right = (num_bin[fi] <= 2) & (missing_type[fi] == MISSING_NAN)
+    default_left = jnp.where(found & force_right, False, default_left)
+
+    left_sum_g = lg.reshape(-1)[idx]
+    left_sum_h_raw = lh.reshape(-1)[idx]
+    left_count = lc.reshape(-1)[idx]
+    right_sum_g = parent_g - left_sum_g
+    right_sum_h_raw = tot_h - left_sum_h_raw
+    right_count = parent_c - left_count
+
+    return SplitResult(
+        found=found,
+        gain=jnp.where(found, best_gain - min_gain_shift, neg_inf),
+        feature=feature,
+        threshold=threshold.astype(jnp.int32),
+        default_left=default_left,
+        left_sum_g=left_sum_g,
+        left_sum_h=left_sum_h_raw - K_EPSILON,
+        left_count=left_count,
+        right_sum_g=right_sum_g,
+        right_sum_h=right_sum_h_raw - K_EPSILON,
+        right_count=right_count,
+        left_output=leaf_output(left_sum_g, left_sum_h_raw, l1, l2),
+        right_output=leaf_output(right_sum_g, right_sum_h_raw, l1, l2),
+    )

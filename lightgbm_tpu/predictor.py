@@ -1,0 +1,170 @@
+"""Prediction paths.
+
+* ``predict_binned_leaf`` — jitted vectorized tree traversal over *binned*
+  features, the analogue of ``Tree::AddPredictionToScore`` /
+  ``NumericalDecisionInner`` (``tree.h:257-313``).  Used every iteration to
+  update validation scores on device and by DART's drop/normalize score
+  arithmetic.
+* ``Predictor`` — host-side batch prediction over raw feature matrices
+  (``src/application/predictor.hpp:24-195`` analogue): raw score, transformed
+  output, leaf indices, with optional margin-based early stopping
+  (``src/boosting/prediction_early_stop.cpp:13-70``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .tree import Tree
+from .utils import log
+
+MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
+
+
+@jax.jit
+def predict_binned_leaf(bins: jnp.ndarray,          # [N, F] int
+                        split_feature: jnp.ndarray,  # [P] i32 (inner index, padded)
+                        threshold_bin: jnp.ndarray,  # [P] i32
+                        default_left: jnp.ndarray,   # [P] bool
+                        left_child: jnp.ndarray,     # [P] i32
+                        right_child: jnp.ndarray,    # [P] i32
+                        feat_info: jnp.ndarray       # [F, 3]: num_bin, missing, default_bin
+                        ) -> jnp.ndarray:
+    """Return leaf index [N] for each row (NumericalDecisionInner semantics).
+
+    Node arrays are padded to a bucketed length P so jit compiles once per
+    size bucket, not per tree.  Padding nodes must have child pointers < 0.
+    """
+    n = bins.shape[0]
+    num_nodes = split_feature.shape[0]
+    node = jnp.zeros((n,), jnp.int32)
+
+    def cond(state):
+        node, _ = state
+        return jnp.any(node >= 0)
+
+    def body(state):
+        node, leaf = state
+        nd = jnp.clip(node, 0, num_nodes - 1)
+        f = split_feature[nd]
+        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        nb = feat_info[f, 0]
+        mt = feat_info[f, 1]
+        db = feat_info[f, 2]
+        is_missing = (((mt == MISSING_NAN) & (b == nb - 1))
+                      | ((mt == MISSING_ZERO) & (b == db)))
+        go_left = jnp.where(is_missing, default_left[nd], b <= threshold_bin[nd])
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        active = node >= 0
+        new_node = jnp.where(active, nxt, node)
+        new_leaf = jnp.where(active & (nxt < 0), ~nxt, leaf)
+        # encode finished rows with node = -1 (any negative stops traversal)
+        return new_node, new_leaf
+
+    node, leaf = lax.while_loop(cond, body, (node, jnp.zeros((n,), jnp.int32)))
+    return leaf
+
+
+def tree_scores_binned(bins: jnp.ndarray, tree: Tree, used_feature_index,
+                       feat_info: jnp.ndarray) -> jnp.ndarray:
+    """Per-row output of one host tree evaluated on binned data [N]."""
+    n = bins.shape[0]
+    nn = tree.num_leaves - 1
+    if nn <= 0:
+        val = tree.leaf_value[0] if len(tree.leaf_value) else 0.0
+        return jnp.full((n,), float(val), jnp.float32)
+    # pad node arrays to a power-of-two bucket: bounded set of jit signatures
+    p = 1
+    while p < nn:
+        p *= 2
+    def pad(a, fill=0):
+        return np.concatenate([np.asarray(a[:nn]),
+                               np.full(p - nn, fill, dtype=np.asarray(a).dtype)])
+    inner = np.asarray([used_feature_index[f] for f in tree.split_feature[:nn]],
+                       dtype=np.int32)
+    leaf = predict_binned_leaf(
+        bins,
+        jnp.asarray(pad(inner)),
+        jnp.asarray(pad(tree.threshold_bin)),
+        jnp.asarray(pad((tree.decision_type[:nn] & 2) > 0, False)),
+        jnp.asarray(pad(tree.left_child, -1)),
+        jnp.asarray(pad(tree.right_child, -1)),
+        feat_info)
+    return jnp.asarray(tree.leaf_value, jnp.float32)[leaf]
+
+
+class Predictor:
+    """Host batch predictor over a trained model (list of Trees)."""
+
+    def __init__(self, trees: List[Tree], num_tree_per_iteration: int,
+                 objective=None, average_output: bool = False,
+                 num_iteration: int = -1,
+                 early_stop: bool = False, early_stop_freq: int = 10,
+                 early_stop_margin: float = 10.0):
+        self.trees = trees
+        self.k = max(num_tree_per_iteration, 1)
+        self.objective = objective
+        self.average_output = average_output
+        total_iters = len(trees) // self.k
+        if num_iteration is not None and num_iteration > 0:
+            self.num_iteration = min(num_iteration, total_iters)
+        else:
+            self.num_iteration = total_iters
+        self.early_stop = early_stop
+        self.early_stop_freq = max(early_stop_freq, 1)
+        self.early_stop_margin = early_stop_margin
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """Raw margin scores [K, N]."""
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        n = X.shape[0]
+        out = np.zeros((self.k, n), dtype=np.float64)
+        if not self.early_stop:
+            for it in range(self.num_iteration):
+                for k in range(self.k):
+                    t = self.trees[it * self.k + k]
+                    out[k] += t.predict(X)
+        else:
+            active = np.ones(n, dtype=bool)
+            for it in range(self.num_iteration):
+                if not active.any():
+                    break
+                idx = np.nonzero(active)[0]
+                for k in range(self.k):
+                    t = self.trees[it * self.k + k]
+                    out[k, idx] += t.predict(X[idx])
+                if (it + 1) % self.early_stop_freq == 0:
+                    margin = self._margin(out[:, idx])
+                    active[idx[margin >= self.early_stop_margin]] = False
+        if self.average_output and self.num_iteration > 0:
+            out /= self.num_iteration
+        return out
+
+    def _margin(self, scores: np.ndarray) -> np.ndarray:
+        """binary: |s|; multiclass: top1 - top2 (prediction_early_stop.cpp)."""
+        if scores.shape[0] == 1:
+            return np.abs(scores[0])
+        srt = np.sort(scores, axis=0)
+        return srt[-1] - srt[-2]
+
+    def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
+        out = self.predict_raw(X)
+        if not raw_score and self.objective is not None:
+            out = np.asarray(self.objective.convert_output(out), dtype=np.float64)
+        if out.shape[0] == 1:
+            return out[0]
+        return out.T  # [N, K] like the reference python package
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        n = X.shape[0]
+        total = self.num_iteration * self.k
+        out = np.zeros((n, total), dtype=np.int32)
+        for i in range(total):
+            out[:, i] = self.trees[i].predict_leaf_index(X)
+        return out

@@ -1,0 +1,308 @@
+"""Host-side tree model: SoA node arrays, prediction, text serialization.
+
+Mirrors the reference ``Tree`` (``include/LightGBM/tree.h:20-370``,
+``src/io/tree.cpp:192-280``):
+
+* same SoA layout (split_feature / threshold / decision_type / children /
+  leaf arrays), with leaves encoded as ``~leaf`` in child pointers;
+* ``decision_type`` bitfield semantics preserved exactly (bit0 categorical,
+  bit1 default-left, bits2-3 missing type — tree.h:157-176) because the text
+  model format is the interop oracle with the reference CLI;
+* ``to_string``/``from_string`` reproduce ``Tree::ToString`` so models can be
+  exchanged with the reference implementation;
+* prediction is vectorized numpy over rows (host) or a jitted traversal over
+  binned features (device, used for valid-set scores during training).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .utils import log
+
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+ZERO_RANGE = 1e-35
+
+MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
+
+
+class Tree:
+    def __init__(self, num_leaves: int):
+        n = max(num_leaves - 1, 0)
+        self.num_leaves = num_leaves
+        self.num_cat = 0
+        self.split_feature = np.zeros(n, dtype=np.int32)   # original feature idx
+        self.split_gain = np.zeros(n, dtype=np.float64)
+        self.threshold = np.zeros(n, dtype=np.float64)     # real-value threshold
+        self.threshold_bin = np.zeros(n, dtype=np.int32)
+        self.decision_type = np.zeros(n, dtype=np.int8)
+        self.left_child = np.zeros(n, dtype=np.int32)
+        self.right_child = np.zeros(n, dtype=np.int32)
+        self.leaf_parent = np.zeros(num_leaves, dtype=np.int32)
+        self.leaf_value = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_count = np.zeros(num_leaves, dtype=np.int64)
+        self.internal_value = np.zeros(n, dtype=np.float64)
+        self.internal_count = np.zeros(n, dtype=np.int64)
+        self.cat_boundaries = np.zeros(1, dtype=np.int32)
+        self.cat_threshold = np.zeros(0, dtype=np.uint32)
+        self.shrinkage = 1.0
+
+    # ------------------------------------------------------------------ build
+
+    @staticmethod
+    def from_arrays(arrays, used_features: Sequence[int], bin_mappers,
+                    num_bin: np.ndarray) -> "Tree":
+        """Convert device TreeArrays (see grower.TreeArrays) to a host Tree.
+
+        ``used_features[i]`` maps inner feature i to the original column;
+        ``bin_mappers`` are the per-original-feature mappers for real
+        thresholds.
+        """
+        nl = int(arrays.num_leaves)
+        t = Tree(nl)
+        if nl <= 1:
+            return t
+        n = nl - 1
+        inner_feat = np.asarray(arrays.split_feature[:n], dtype=np.int32)
+        t.split_feature = np.asarray([used_features[i] for i in inner_feat],
+                                     dtype=np.int32)
+        t.threshold_bin = np.asarray(arrays.threshold_bin[:n], dtype=np.int32)
+        t.split_gain = np.asarray(arrays.split_gain[:n], dtype=np.float64)
+        t.left_child = np.asarray(arrays.left_child[:n], dtype=np.int32)
+        t.right_child = np.asarray(arrays.right_child[:n], dtype=np.int32)
+        t.leaf_parent = np.asarray(arrays.leaf_parent[:nl], dtype=np.int32)
+        t.leaf_value = np.asarray(arrays.leaf_value[:nl], dtype=np.float64)
+        t.leaf_count = np.asarray(np.round(arrays.leaf_count[:nl]), dtype=np.int64)
+        t.internal_value = np.asarray(arrays.internal_value[:n], dtype=np.float64)
+        t.internal_count = np.asarray(np.round(arrays.internal_count[:n]),
+                                      dtype=np.int64)
+        default_left = np.asarray(arrays.default_left[:n], dtype=bool)
+        thresholds = np.zeros(n, dtype=np.float64)
+        dtypes = np.zeros(n, dtype=np.int8)
+        for i in range(n):
+            mapper = bin_mappers[t.split_feature[i]]
+            thresholds[i] = mapper.bin_to_value(int(t.threshold_bin[i]))
+            dt = 0
+            if default_left[i]:
+                dt |= K_DEFAULT_LEFT_MASK
+            dt |= (mapper.missing_type & 3) << 2
+            dtypes[i] = dt
+        t.threshold = thresholds
+        t.decision_type = dtypes
+        return t
+
+    # ---------------------------------------------------------------- helpers
+
+    def missing_type(self, node: int) -> int:
+        return (int(self.decision_type[node]) >> 2) & 3
+
+    def default_left(self, node: int) -> bool:
+        return bool(self.decision_type[node] & K_DEFAULT_LEFT_MASK)
+
+    def is_categorical(self, node: int) -> bool:
+        return bool(self.decision_type[node] & K_CATEGORICAL_MASK)
+
+    def shrink(self, rate: float) -> None:
+        """Tree::Shrinkage (tree.h:130-137)."""
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def cat_bitset(self, node: int) -> np.ndarray:
+        ci = int(self.threshold[node])
+        return self.cat_threshold[self.cat_boundaries[ci]:self.cat_boundaries[ci + 1]]
+
+    # ---------------------------------------------------------------- predict
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized raw-feature traversal (tree.h:231-313 decision semantics)."""
+        X = np.asarray(X, dtype=np.float64)
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, self.leaf_value[0] if len(self.leaf_value) else 0.0)
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        out = np.zeros(n, dtype=np.float64)
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            fv = X[idx, self.split_feature[nd]]
+            go_left = np.zeros(len(idx), dtype=bool)
+            cat_mask = (self.decision_type[nd] & K_CATEGORICAL_MASK) > 0
+            # numerical decision
+            num_sel = ~cat_mask
+            if num_sel.any():
+                v = fv[num_sel]
+                nn = nd[num_sel]
+                mt = (self.decision_type[nn].astype(np.int32) >> 2) & 3
+                dl = (self.decision_type[nn] & K_DEFAULT_LEFT_MASK) > 0
+                nan_mask = np.isnan(v)
+                v = np.where(nan_mask & (mt != MISSING_NAN), 0.0, v)
+                is_missing = ((mt == MISSING_ZERO) & (np.abs(v) <= ZERO_RANGE)) | \
+                             ((mt == MISSING_NAN) & nan_mask)
+                gl = np.where(is_missing, dl, v <= self.threshold[nn])
+                go_left[num_sel] = gl
+            if cat_mask.any():
+                v = fv[cat_mask]
+                nn = nd[cat_mask]
+                gl = np.zeros(len(nn), dtype=bool)
+                for k in range(len(nn)):
+                    gl[k] = self._cat_decision(v[k], int(nn[k]))
+                go_left[cat_mask] = gl
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            is_leaf = nxt < 0
+            leaf_ids = ~nxt[is_leaf]
+            out[idx[is_leaf]] = self.leaf_value[leaf_ids]
+            node[idx] = np.where(is_leaf, 0, nxt)
+            active[idx[is_leaf]] = False
+        return out
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        out = np.zeros(n, dtype=np.int32)
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            fv = X[idx, self.split_feature[nd]]
+            mt = (self.decision_type[nd].astype(np.int32) >> 2) & 3
+            dl = (self.decision_type[nd] & K_DEFAULT_LEFT_MASK) > 0
+            cat_mask = (self.decision_type[nd] & K_CATEGORICAL_MASK) > 0
+            nan_mask = np.isnan(fv)
+            v = np.where(nan_mask & (mt != MISSING_NAN), 0.0, fv)
+            is_missing = ((mt == MISSING_ZERO) & (np.abs(v) <= ZERO_RANGE)) | \
+                         ((mt == MISSING_NAN) & nan_mask)
+            go_left = np.where(is_missing, dl, v <= self.threshold[nd])
+            if cat_mask.any():
+                for k in np.nonzero(cat_mask)[0]:
+                    go_left[k] = self._cat_decision(fv[k], int(nd[k]))
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            is_leaf = nxt < 0
+            out[idx[is_leaf]] = ~nxt[is_leaf]
+            node[idx] = np.where(is_leaf, 0, nxt)
+            active[idx[is_leaf]] = False
+        return out
+
+    def _cat_decision(self, fval: float, node: int) -> bool:
+        """CategoricalDecision (tree.h:268-283)."""
+        if np.isnan(fval):
+            if self.missing_type(node) == MISSING_NAN:
+                return False
+            fval = 0.0
+        int_val = int(fval)
+        if int_val < 0:
+            return False
+        bitset = self.cat_bitset(node)
+        i1, i2 = int_val // 32, int_val % 32
+        if i1 < len(bitset):
+            return bool((int(bitset[i1]) >> i2) & 1)
+        return False
+
+    # -------------------------------------------------------------- serialize
+
+    def to_string(self, index: int) -> str:
+        n = self.num_leaves - 1
+        lines = [f"Tree={index}",
+                 f"num_leaves={self.num_leaves}",
+                 f"num_cat={self.num_cat}",
+                 "split_feature=" + _join_int(self.split_feature[:n]),
+                 "split_gain=" + _join_float(self.split_gain[:n]),
+                 "threshold=" + _join_float(self.threshold[:n]),
+                 "decision_type=" + _join_int(self.decision_type[:n]),
+                 "left_child=" + _join_int(self.left_child[:n]),
+                 "right_child=" + _join_int(self.right_child[:n]),
+                 "leaf_parent=" + _join_int(self.leaf_parent[:self.num_leaves]),
+                 "leaf_value=" + _join_float(self.leaf_value[:self.num_leaves]),
+                 "leaf_count=" + _join_int(self.leaf_count[:self.num_leaves]),
+                 "internal_value=" + _join_float(self.internal_value[:n]),
+                 "internal_count=" + _join_int(self.internal_count[:n])]
+        if self.num_cat > 0:
+            lines.append("cat_boundaries=" + _join_int(self.cat_boundaries))
+            lines.append("cat_threshold=" + _join_int(self.cat_threshold))
+        lines.append(f"shrinkage={self.shrinkage:.17g}")
+        lines.append("")
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def from_string(block: str) -> "Tree":
+        kv: Dict[str, str] = {}
+        for line in block.splitlines():
+            line = line.strip()
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k] = v
+        nl = int(kv["num_leaves"])
+        t = Tree(nl)
+        t.num_cat = int(kv.get("num_cat", "0"))
+        n = nl - 1
+        if n > 0:
+            t.split_feature = _parse_arr(kv["split_feature"], np.int32, n)
+            t.split_gain = _parse_arr(kv.get("split_gain", ""), np.float64, n)
+            t.threshold = _parse_arr(kv["threshold"], np.float64, n)
+            t.decision_type = _parse_arr(kv["decision_type"], np.int8, n)
+            t.left_child = _parse_arr(kv["left_child"], np.int32, n)
+            t.right_child = _parse_arr(kv["right_child"], np.int32, n)
+            t.internal_value = _parse_arr(kv.get("internal_value", ""), np.float64, n)
+            t.internal_count = _parse_arr(kv.get("internal_count", ""), np.int64, n)
+        t.leaf_parent = _parse_arr(kv.get("leaf_parent", ""), np.int32, nl)
+        t.leaf_value = _parse_arr(kv["leaf_value"], np.float64, nl)
+        t.leaf_count = _parse_arr(kv.get("leaf_count", ""), np.int64, nl)
+        if t.num_cat > 0:
+            t.cat_boundaries = _parse_arr(kv["cat_boundaries"], np.int32,
+                                          t.num_cat + 1)
+            t.cat_threshold = _parse_arr(kv["cat_threshold"], np.uint32, -1)
+        t.shrinkage = float(kv.get("shrinkage", "1"))
+        return t
+
+    def to_json(self, index: int) -> Dict:
+        """Tree::ToJSON (tree.cpp:229+) as a python dict."""
+        def node_json(node: int) -> Dict:
+            if node < 0:
+                leaf = ~node
+                return {"leaf_index": int(leaf),
+                        "leaf_value": float(self.leaf_value[leaf]),
+                        "leaf_count": int(self.leaf_count[leaf])}
+            return {
+                "split_index": int(node),
+                "split_feature": int(self.split_feature[node]),
+                "split_gain": float(self.split_gain[node]),
+                "threshold": float(self.threshold[node]),
+                "decision_type": "categorical" if self.is_categorical(node) else "<=",
+                "default_left": self.default_left(node),
+                "missing_type": ["None", "Zero", "NaN"][self.missing_type(node)],
+                "internal_value": float(self.internal_value[node]),
+                "internal_count": int(self.internal_count[node]),
+                "left_child": node_json(int(self.left_child[node])),
+                "right_child": node_json(int(self.right_child[node])),
+            }
+        root = node_json(0) if self.num_leaves > 1 else {
+            "leaf_index": 0,
+            "leaf_value": float(self.leaf_value[0]) if len(self.leaf_value) else 0.0,
+            "leaf_count": int(self.leaf_count[0]) if len(self.leaf_count) else 0}
+        return {"tree_index": index, "num_leaves": int(self.num_leaves),
+                "num_cat": int(self.num_cat), "shrinkage": float(self.shrinkage),
+                "tree_structure": root}
+
+
+def _join_int(arr) -> str:
+    return " ".join(str(int(v)) for v in arr)
+
+
+def _join_float(arr) -> str:
+    return " ".join(f"{float(v):.17g}" for v in arr)
+
+
+def _parse_arr(s: str, dtype, expect: int) -> np.ndarray:
+    parts = s.split()
+    if expect >= 0 and len(parts) != expect:
+        if not parts:
+            return np.zeros(expect, dtype=dtype)
+    if dtype in (np.float64, np.float32):
+        return np.asarray([float(p) for p in parts], dtype=dtype)
+    return np.asarray([int(float(p)) for p in parts], dtype=dtype)

@@ -1,0 +1,60 @@
+"""Test configuration: force a virtual 8-device CPU mesh before jax loads.
+
+Sharding/collective tests run against CPU devices standing in for TPU chips —
+the "fake backend" discipline the reference uses for its GPU CI
+(.travis/test.sh runs the OpenCL suite on CPU drivers).
+"""
+import os
+
+# Force an 8-virtual-device CPU mesh for the suite.  The container's
+# sitecustomize may have registered the axon TPU plugin (importing jax at
+# interpreter startup), so the platform must be switched via the live jax
+# config, not env vars.  XLA_FLAGS still works because the CPU client is
+# created lazily.  Set LGBM_TPU_TESTS_ON_TPU=1 to run against the real chip.
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+if os.environ.get("LGBM_TPU_TESTS_ON_TPU") != "1":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def binary_example():
+    """Reference bundled binary classification example (7000 x 28)."""
+    path = "/root/reference/examples/binary_classification/binary.train"
+    test_path = "/root/reference/examples/binary_classification/binary.test"
+    if os.path.exists(path):
+        train = np.loadtxt(path)
+        test = np.loadtxt(test_path)
+    else:  # fallback synthetic data with similar shape
+        rng = np.random.RandomState(0)
+        w = rng.randn(28)
+        X = rng.randn(7500, 28)
+        y = (X @ w + 0.5 * rng.randn(7500) > 0).astype(np.float64)
+        data = np.column_stack([y, X])
+        train, test = data[:7000], data[7000:]
+    return (train[:, 1:], train[:, 0], test[:, 1:], test[:, 0])
+
+
+@pytest.fixture(scope="session")
+def regression_example():
+    path = "/root/reference/examples/regression/regression.train"
+    test_path = "/root/reference/examples/regression/regression.test"
+    if os.path.exists(path):
+        train = np.loadtxt(path)
+        test = np.loadtxt(test_path)
+    else:
+        rng = np.random.RandomState(1)
+        w = rng.randn(28)
+        X = rng.randn(7500, 28)
+        y = X @ w + 0.3 * rng.randn(7500)
+        data = np.column_stack([y, X])
+        train, test = data[:7000], data[7000:]
+    return (train[:, 1:], train[:, 0], test[:, 1:], test[:, 0])

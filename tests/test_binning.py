@@ -1,0 +1,84 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.data.binning import (BIN_TYPE_CATEGORICAL, BinMapper,
+                                       MISSING_NAN, MISSING_NONE, MISSING_ZERO,
+                                       greedy_find_bin)
+
+
+def test_greedy_find_bin_few_distinct():
+    vals = np.array([1.0, 2.0, 3.0])
+    counts = np.array([10, 10, 10])
+    bounds = greedy_find_bin(vals, counts, max_bin=255, total_cnt=30,
+                             min_data_in_bin=3)
+    assert bounds[-1] == np.inf
+    assert bounds[0] == pytest.approx(1.5)
+    assert bounds[1] == pytest.approx(2.5)
+
+
+def test_bin_mapper_roundtrip():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(10000)
+    m = BinMapper.fit(vals, total_sample_cnt=10000, max_bin=255,
+                      min_data_in_bin=3, min_split_data=0)
+    assert not m.is_trivial
+    assert m.num_bin <= 255
+    bins = m.value_to_bin(vals)
+    # monotone: larger value -> bin index >= smaller value's bin
+    order = np.argsort(vals)
+    assert (np.diff(bins[order]) >= 0).all()
+    # bin upper bounds respected
+    for b in range(m.num_bin - 1):
+        sel = bins == b
+        if sel.any():
+            assert vals[sel].max() <= m.bin_upper_bound[b] + 1e-12
+
+
+def test_bin_mapper_missing_nan():
+    vals = np.array([1.0, 2.0, np.nan, 3.0, np.nan, 4.0] * 10)
+    m = BinMapper.fit(vals, total_sample_cnt=60, max_bin=255,
+                      min_data_in_bin=1, min_split_data=0)
+    assert m.missing_type == MISSING_NAN
+    bins = m.value_to_bin(np.array([np.nan, 1.0]))
+    assert bins[0] == m.num_bin - 1   # NaN bin is last
+    assert bins[1] < m.num_bin - 1
+
+
+def test_bin_mapper_zero_as_missing():
+    vals = np.array([0.0] * 50 + list(np.linspace(-5, 5, 100)))
+    m = BinMapper.fit(vals, total_sample_cnt=150, max_bin=64,
+                      min_data_in_bin=1, min_split_data=0,
+                      zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    assert m.value_to_bin_scalar(0.0) == m.default_bin
+
+
+def test_bin_mapper_trivial():
+    # constant feature: the phantom zero bin is empty, so any nonzero
+    # min_split_data filters it (bin.cpp NeedFilter semantics)
+    vals = np.full(100, 7.0)
+    m = BinMapper.fit(vals, total_sample_cnt=100, max_bin=255,
+                      min_data_in_bin=3, min_split_data=1)
+    assert m.is_trivial
+
+
+def test_bin_mapper_categorical():
+    rng = np.random.RandomState(1)
+    vals = rng.choice([1, 2, 3, 5, 8], size=1000,
+                      p=[0.4, 0.3, 0.15, 0.1, 0.05]).astype(np.float64)
+    m = BinMapper.fit(vals, total_sample_cnt=1000, max_bin=255,
+                      min_data_in_bin=1, min_split_data=0,
+                      bin_type=BIN_TYPE_CATEGORICAL)
+    assert m.bin_type == BIN_TYPE_CATEGORICAL
+    # most frequent category gets bin 0 (unless it's category 0)
+    assert m.bin_2_categorical[0] == 1
+    bins = m.value_to_bin(np.array([1.0, 2.0, 999.0]))
+    assert bins[0] == 0
+    assert bins[2] == m.num_bin - 1  # unseen category -> last bin
+
+
+def test_default_bin_is_zero_bin():
+    vals = np.array([0.0] * 500 + list(np.linspace(1, 10, 500)))
+    m = BinMapper.fit(vals, total_sample_cnt=1000, max_bin=32,
+                      min_data_in_bin=1, min_split_data=0)
+    assert m.value_to_bin_scalar(0.0) == m.default_bin
