@@ -1,0 +1,83 @@
+"""Benchmark: Higgs-shaped GBDT training throughput on TPU.
+
+Workload mirrors the reference's headline benchmark config
+(docs/GPU-Performance.md:101-117): binary objective, 255 leaves, 255 bins,
+min_data_in_leaf=1, min_sum_hessian_in_leaf=100, lr=0.1, 28 dense features.
+Rows default to 1M (BENCH_ROWS overrides; the published Higgs is 10.5M).
+
+Baseline: the reference v2.0.5 CLI measured on THIS host (1 CPU core,
+identical synthetic data/config): 0.4283 s/tree = 2.336 trees/s.  The
+published numbers use a 28-core Xeon; we scale the measured single-core
+throughput linearly by 28 (optimistic for the CPU — LightGBM scales
+sublinearly) to get a conservative stand-in: 65.4 trees/s.
+``vs_baseline`` = our trees/s divided by that.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TREES_PER_SEC = 2.336 * 28  # see module docstring
+
+
+def make_data(n, f=28, seed=42):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    X[:, ::4] = np.abs(X[:, ::4]) + 0.1
+    mask = rng.rand(n, f // 7) < 0.3
+    X[:, :f // 7][mask] = 0.0
+    w = rng.randn(f) * 0.5
+    y = ((X @ w + rng.randn(n)) > 0).astype(np.float32)
+    return X, y
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    n_timed = int(os.environ.get("BENCH_TREES", 10))
+    import jax
+    from lightgbm_tpu.config import config_from_params
+    from lightgbm_tpu.data.dataset import construct
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+
+    platform = jax.devices()[0].platform
+    X, y = make_data(n_rows)
+    params = {
+        "objective": "binary",
+        "num_leaves": 255,
+        "max_bin": 255,
+        "min_data_in_leaf": 1,
+        "min_sum_hessian_in_leaf": 100,
+        "learning_rate": 0.1,
+        "verbose": -1,
+        "use_pallas": platform == "tpu",
+    }
+    cfg = config_from_params(params)
+    ds = construct(X, cfg, label=y)
+    booster = create_boosting(cfg, ds, create_objective(cfg))
+
+    # warmup (compile)
+    booster.train_one_iter()
+    jax.block_until_ready(booster.scores)
+    t0 = time.perf_counter()
+    for _ in range(n_timed):
+        booster.train_one_iter()
+    jax.block_until_ready(booster.scores)
+    dt = time.perf_counter() - t0
+    trees_per_sec = n_timed / dt
+
+    print(json.dumps({
+        "metric": f"higgs-like {n_rows // 1000}k x28 binary GBDT training "
+                  f"throughput, 255 leaves, 255 bins ({platform})",
+        "value": round(trees_per_sec, 4),
+        "unit": "trees/sec",
+        "vs_baseline": round(trees_per_sec / BASELINE_TREES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

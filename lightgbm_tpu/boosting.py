@@ -107,7 +107,7 @@ class GBDT:
             max_bin=train.max_num_bin(),
             hist_method=("pallas" if cfg.use_pallas and _on_tpu() else "auto"),
             rows_per_chunk=cfg.rows_per_chunk or 16384)
-        self.grow = jax.jit(make_grower(self.grower_cfg))
+        self._setup_grower(cfg, train)
 
         self.objective.init(train.metadata, n)
         self.num_class = self.objective.num_tree_per_iteration
@@ -135,6 +135,49 @@ class GBDT:
             return scores_k + lr * leaf_values[row_leaf]
 
         self._update_score = _update_score
+
+    def _setup_grower(self, cfg: Config, train: TrainingData) -> None:
+        """Select the tree learner (CreateTreeLearner analogue):
+        serial on one device; data/feature/voting over the device mesh."""
+        self._row_pad = 0
+        self._feat_pad = 0
+        n_devices = len(jax.devices())
+        use_dist = cfg.tree_learner != "serial" and (
+            cfg.mesh_devices != 1 and n_devices > 1)
+        if not use_dist:
+            if cfg.tree_learner != "serial":
+                log.warning("tree_learner=%s requested but only one device is "
+                            "in use (devices=%d, mesh_devices=%d); falling "
+                            "back to serial", cfg.tree_learner, n_devices,
+                            cfg.mesh_devices)
+            self.grow = jax.jit(make_grower(self.grower_cfg))
+            return
+        from .parallel.learner import make_distributed_grower
+        from .parallel.mesh import make_mesh, pad_features, pad_rows
+        axis = "feature" if cfg.tree_learner == "feature" else "data"
+        mesh = make_mesh(cfg.mesh_devices or 0, axis)
+        shards = int(mesh.devices.size)
+        n = self.num_data
+        f = len(train.used_features)
+        if cfg.tree_learner in ("data", "voting"):
+            self._row_pad = pad_rows(n, shards)
+            if self._row_pad:
+                self.bins = jnp.pad(self.bins, ((0, self._row_pad), (0, 0)))
+        else:
+            self._feat_pad = pad_features(f, shards)
+            if self._feat_pad:
+                self.bins = jnp.pad(self.bins, ((0, 0), (0, self._feat_pad)))
+                pad1 = lambda a, v: jnp.pad(a, (0, self._feat_pad),
+                                            constant_values=v)
+                self.meta = FeatureMeta(
+                    num_bin=pad1(self.meta.num_bin, 1),
+                    missing_type=pad1(self.meta.missing_type, 0),
+                    default_bin=pad1(self.meta.default_bin, 0),
+                    is_categorical=pad1(self.meta.is_categorical, False))
+        log.info("Using %s-parallel tree learner over %d devices",
+                 cfg.tree_learner, shards)
+        self.grow = make_distributed_grower(self.grower_cfg, mesh,
+                                            cfg.tree_learner, cfg.top_k)
 
     def _make_metrics(self, data: TrainingData) -> List[Metric]:
         out = []
@@ -216,11 +259,22 @@ class GBDT:
 
         lr = self._shrinkage_rate()
         any_split = False
-        feat_mask = jnp.asarray(self._feature_sample())
+        feat_mask = np.asarray(self._feature_sample())
+        if self._feat_pad:
+            feat_mask = np.concatenate(
+                [feat_mask, np.zeros(self._feat_pad, dtype=bool)])
+        feat_mask = jnp.asarray(feat_mask)
+
+        def padded(x):
+            return jnp.pad(x, (0, self._row_pad)) if self._row_pad else x
+
         for k in range(self.num_class):
-            arrays, row_leaf = self.grow(self.bins, g[k] * self._bag_weight,
-                                         h[k] * self._bag_weight,
-                                         cnt, self.meta, feat_mask)
+            arrays, row_leaf = self.grow(self.bins,
+                                         padded(g[k] * self._bag_weight),
+                                         padded(h[k] * self._bag_weight),
+                                         padded(cnt), self.meta, feat_mask)
+            if self._row_pad:
+                row_leaf = row_leaf[:self.num_data]
             num_leaves = int(arrays.num_leaves)
             tree = Tree.from_arrays(arrays, self.train_set.used_features,
                                     self.train_set.bin_mappers,
@@ -258,6 +312,12 @@ class GBDT:
     def _after_iter(self) -> None:
         pass
 
+    def _train_tree_score(self, tree: Tree) -> jnp.ndarray:
+        """Per-row contribution of a tree on the (possibly padded) train bins."""
+        s = tree_scores_binned(self.bins, tree, self.used_feature_index,
+                               self.feat_info)
+        return s[:self.num_data] if self._row_pad else s
+
     def rollback_one_iter(self) -> None:
         """gbdt.cpp:583-600."""
         if self.iter_ <= 0:
@@ -266,8 +326,7 @@ class GBDT:
             tree = self.models.pop()
             if tree.num_leaves > 1:
                 tree.shrink(-1.0)
-                self.scores = self.scores.at[k].add(tree_scores_binned(
-                    self.bins, tree, self.used_feature_index, self.feat_info))
+                self.scores = self.scores.at[k].add(self._train_tree_score(tree))
                 for vs in self.valid_sets:
                     vs.scores = vs.scores.at[k].add(tree_scores_binned(
                         vs.bins, tree, self.used_feature_index, self.feat_info))
@@ -442,8 +501,11 @@ class DART(GBDT):
         self._shrinkage = config.learning_rate
 
     def _tree_score(self, tree, bins):
-        return tree_scores_binned(bins, tree, self.used_feature_index,
-                                  self.feat_info)
+        s = tree_scores_binned(bins, tree, self.used_feature_index,
+                               self.feat_info)
+        if bins is self.bins and self._row_pad:
+            s = s[:self.num_data]
+        return s
 
     def _select_drop(self) -> None:
         cfg = self.config

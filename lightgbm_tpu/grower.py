@@ -20,8 +20,7 @@ O(N) training-score update.
 """
 from __future__ import annotations
 
-import functools
-from typing import Callable, NamedTuple, Optional
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +81,43 @@ class _LoopState(NamedTuple):
     tree: TreeArrays
 
 
+class SerialStrategy:
+    """Single-device learner (SerialTreeLearner analogue).
+
+    A strategy supplies three traced hooks to the grower; the parallel tree
+    learners of the reference (data / feature / voting,
+    ``src/treelearner/*parallel*``) are alternative strategies in
+    ``lightgbm_tpu.parallel.learner``:
+
+    * ``setup(bins, meta, feat_valid) -> ctx``  — per-shard views
+    * ``hist(ctx, seg, gw, hw, cw) -> [2, F', B, 3]`` — child histograms,
+      reduced across the mesh as the strategy requires
+    * ``find(ctx, hist_child, pg, ph, pc) -> SplitResult`` — globally agreed
+      best split (feature indices in the full/global numbering)
+    * ``reduce_scalar(x)`` — global sums of row statistics
+    """
+
+    def __init__(self, cfg: "GrowerConfig"):
+        self.cfg = cfg
+
+    def setup(self, bins, meta: FeatureMeta, feat_valid):
+        return (meta, feat_valid)
+
+    def hist(self, ctx, bins, seg, gw, hw, cw):
+        return child_histograms(bins, seg, gw, hw, cw, self.cfg.max_bin,
+                                method=self.cfg.hist_method,
+                                rows_per_chunk=self.cfg.rows_per_chunk)
+
+    def find(self, ctx, hist_child, pg, ph, pc):
+        meta, feat_valid = ctx
+        return best_split(hist_child, pg, ph, pc, meta.num_bin,
+                          meta.missing_type, meta.default_bin, feat_valid,
+                          self.cfg.split_config())
+
+    def reduce_scalar(self, x):
+        return x
+
+
 def _set(arr, idx, value):
     return arr.at[idx].set(value)
 
@@ -100,32 +136,17 @@ def _depth_gate(res: SplitResult, leaf_depth, max_depth) -> SplitResult:
                         gain=jnp.where(ok, res.gain, -jnp.inf))
 
 
-def make_grower(cfg: GrowerConfig,
-                reduce_hist: Optional[Callable] = None,
-                local_count: Optional[Callable] = None) -> Callable:
+def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
     """Build the jittable ``grow_tree`` function.
 
-    ``reduce_hist(hist)`` — identity for single device; ``lax.psum`` over the
-    data axis inside ``shard_map`` for the data-parallel learner.
-    ``local_count`` — same idea for scalar row statistics.
+    ``strategy`` selects the (distributed) learner; default is the
+    single-device :class:`SerialStrategy`.  This mirrors the reference's
+    ``CreateTreeLearner`` factory (tree_learner.cpp:9-33) with strategies in
+    place of subclass overrides.
     """
     L = cfg.num_leaves
-    B = cfg.max_bin
-    scfg = cfg.split_config()
-    if reduce_hist is None:
-        reduce_hist = lambda x: x
-    if local_count is None:
-        local_count = lambda x: x
-
-    def hist_fn(bins, seg, gw, hw, cw):
-        h = child_histograms(bins, seg, gw, hw, cw, B,
-                             method=cfg.hist_method,
-                             rows_per_chunk=cfg.rows_per_chunk)
-        return reduce_hist(h)
-
-    def find(hist_child, pg, ph, pc, meta: FeatureMeta, feat_valid):
-        return best_split(hist_child, pg, ph, pc, meta.num_bin,
-                          meta.missing_type, meta.default_bin, feat_valid, scfg)
+    if strategy is None:
+        strategy = SerialStrategy(cfg)
 
     def grow_tree(bins: jnp.ndarray,        # [N, F] uint8/uint16/int32
                   gw: jnp.ndarray,          # [N] f32   grad * bag_weight
@@ -136,15 +157,19 @@ def make_grower(cfg: GrowerConfig,
                   ):
         n, f = bins.shape
         dtype = gw.dtype
+        ctx = strategy.setup(bins, meta, feat_valid)
 
-        root_g = local_count(jnp.sum(gw))
-        root_h = local_count(jnp.sum(hw))
-        root_c = local_count(jnp.sum(cw))
+        def find(hist_child, pg, ph, pc):
+            return strategy.find(ctx, hist_child, pg, ph, pc)
+
+        root_g = strategy.reduce_scalar(jnp.sum(gw))
+        root_h = strategy.reduce_scalar(jnp.sum(hw))
+        root_c = strategy.reduce_scalar(jnp.sum(cw))
 
         row_leaf = jnp.zeros((n,), jnp.int32)
         seg0 = jnp.zeros((n,), jnp.int32)   # all rows in "left" slot -> root hist
-        hist_root = hist_fn(bins, seg0, gw, hw, cw)[0]
-        res_root = find(hist_root, root_g, root_h, root_c, meta, feat_valid)
+        hist_root = strategy.hist(ctx, bins, seg0, gw, hw, cw)[0]
+        res_root = find(hist_root, root_g, root_h, root_c)
         res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
 
         def blank_res(x):
@@ -238,11 +263,11 @@ def make_grower(cfg: GrowerConfig,
             # --- histograms + best splits for both children in one sweep -----
             seg = jnp.where(row_leaf == l, 0,
                             jnp.where(row_leaf == new_leaf, 1, 2))
-            hist2 = hist_fn(bins, seg, gw, hw, cw)
+            hist2 = strategy.hist(ctx, bins, seg, gw, hw, cw)
             res_l = find(hist2[0], splits.left_sum_g[l], splits.left_sum_h[l],
-                         splits.left_count[l], meta, feat_valid)
+                         splits.left_count[l])
             res_r = find(hist2[1], splits.right_sum_g[l], splits.right_sum_h[l],
-                         splits.right_count[l], meta, feat_valid)
+                         splits.right_count[l])
             res_l = _depth_gate(res_l, child_depth, cfg.max_depth)
             res_r = _depth_gate(res_r, child_depth, cfg.max_depth)
 

@@ -67,17 +67,11 @@ def leaf_output(sum_g, sum_h, l1, l2):
     return -jnp.sign(sum_g) * reg / (sum_h + l2)
 
 
-def best_split(hist: jnp.ndarray,
-               parent_g: jnp.ndarray, parent_h: jnp.ndarray, parent_c: jnp.ndarray,
-               num_bin: jnp.ndarray, missing_type: jnp.ndarray,
-               default_bin: jnp.ndarray, feat_valid: jnp.ndarray,
-               cfg: SplitConfig) -> SplitResult:
-    """Best numerical split across all features of one leaf.
-
-    hist: [F, B, 3] (sum_g, sum_h, count); num_bin/missing_type/default_bin:
-    [F] i32; feat_valid: [F] bool (feature_fraction & non-trivial &
-    non-categorical).  parent_*: scalars for the leaf.
-    """
+def _candidate_arrays(hist, parent_g, parent_h, parent_c,
+                      num_bin, missing_type, default_bin, feat_valid, cfg):
+    """Packed per-candidate arrays [F, 2B] in reference tie-break order:
+    per feature, dir=-1 candidates (largest threshold first) then dir=+1
+    ascending.  Invalid candidates carry gain = -inf."""
     dtype = hist.dtype
     f, b, _ = hist.shape
     g = hist[:, :, 0]
@@ -160,17 +154,22 @@ def best_split(hist: jnp.ndarray,
     lc = pack(lc_m1, lc_p1)
     thr = pack(bins, bins)  # pack() flips the dir=-1 half itself
     is_m1 = pack(jnp.ones_like(bins, dtype=bool), jnp.zeros_like(bins, dtype=bool))
+    return gains, lg, lh, lc, thr, is_m1, min_gain_shift, tot_h, l1, l2
 
-    flat_gains = gains.reshape(-1)
-    idx = jnp.argmax(flat_gains)
-    best_gain = flat_gains[idx]
+
+def _result_from_index(idx, gains_flat, lg, lh, lc, thr, is_m1,
+                       parent_g, parent_c, num_bin, missing_type,
+                       min_gain_shift, tot_h, l1, l2, nf, b, feature_base=0):
+    """Assemble a SplitResult from a flat candidate index into [F, 2B]."""
+    neg_inf = jnp.asarray(-jnp.inf, gains_flat.dtype)
+    best_gain = gains_flat[idx]
     found = best_gain > neg_inf
-
-    feature = jnp.where(found, (idx // (2 * b)).astype(jnp.int32), -1)
+    feature_local = (idx // (2 * b)).astype(jnp.int32)
+    feature = jnp.where(found, feature_local + feature_base, -1)
     threshold = jnp.where(found, thr.reshape(-1)[idx], 0)
     default_left = jnp.where(found, is_m1.reshape(-1)[idx], True)
     # 2-bin NaN features always default right (feature_histogram.hpp:97-100)
-    fi = jnp.clip(feature, 0, f - 1)
+    fi = jnp.clip(feature_local, 0, nf - 1)
     force_right = (num_bin[fi] <= 2) & (missing_type[fi] == MISSING_NAN)
     default_left = jnp.where(found & force_right, False, default_left)
 
@@ -196,3 +195,47 @@ def best_split(hist: jnp.ndarray,
         left_output=leaf_output(left_sum_g, left_sum_h_raw, l1, l2),
         right_output=leaf_output(right_sum_g, right_sum_h_raw, l1, l2),
     )
+
+
+def best_split(hist: jnp.ndarray,
+               parent_g: jnp.ndarray, parent_h: jnp.ndarray, parent_c: jnp.ndarray,
+               num_bin: jnp.ndarray, missing_type: jnp.ndarray,
+               default_bin: jnp.ndarray, feat_valid: jnp.ndarray,
+               cfg: SplitConfig, feature_base: int = 0) -> SplitResult:
+    """Best numerical split across all features of one leaf.
+
+    hist: [F, B, 3] (sum_g, sum_h, count); num_bin/missing_type/default_bin:
+    [F] i32; feat_valid: [F] bool (feature_fraction & non-trivial &
+    non-categorical).  parent_*: scalars for the leaf.  ``feature_base``
+    offsets the reported feature index (feature-parallel shards).
+    """
+    f, b, _ = hist.shape
+    (gains, lg, lh, lc, thr, is_m1,
+     min_gain_shift, tot_h, l1, l2) = _candidate_arrays(
+        hist, parent_g, parent_h, parent_c, num_bin, missing_type,
+        default_bin, feat_valid, cfg)
+    flat = gains.reshape(-1)
+    idx = jnp.argmax(flat)
+    return _result_from_index(idx, flat, lg, lh, lc, thr, is_m1,
+                              parent_g, parent_c, num_bin, missing_type,
+                              min_gain_shift, tot_h, l1, l2, f, b,
+                              feature_base)
+
+
+def per_feature_best_gain(hist: jnp.ndarray,
+                          parent_g, parent_h, parent_c,
+                          num_bin, missing_type, default_bin, feat_valid,
+                          cfg: SplitConfig) -> jnp.ndarray:
+    """Best gain per feature [F] (gain - gain_shift; -inf if unsplittable).
+
+    Used by the voting-parallel learner to pick each worker's top-k vote
+    features (voting_parallel_tree_learner.cpp:255-330)."""
+    (gains, _, _, _, _, _, min_gain_shift, _, _, _) = _candidate_arrays(
+        hist, parent_g, parent_h, parent_c, num_bin, missing_type,
+        default_bin, feat_valid, cfg)
+    best = jnp.max(gains, axis=1)
+    # parent sums may be per-feature [F, 1] (voting learner's local stats)
+    shift = jnp.asarray(min_gain_shift)
+    if shift.ndim:
+        shift = shift.reshape(-1)
+    return jnp.where(best > -jnp.inf, best - shift, -jnp.inf)

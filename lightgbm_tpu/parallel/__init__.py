@@ -1,0 +1,4 @@
+from . import mesh  # noqa: F401
+from .learner import (DataParallelStrategy, FeatureParallelStrategy,  # noqa: F401
+                      VotingStrategy, make_distributed_grower)
+from .mesh import make_mesh  # noqa: F401

@@ -1,0 +1,57 @@
+"""Device-mesh utilities.
+
+The reference's distributed substrate is a hand-built TCP/MPI collective layer
+(``src/network/``: Bruck allgather, recursive-halving reduce-scatter over a
+machine-list file).  On TPU the entire layer collapses to ``jax.sharding.Mesh``
+axes + XLA collectives over ICI/DCN: machine-list → mesh construction,
+rank → ``lax.axis_index``, Allreduce/ReduceScatter → ``lax.psum`` /
+``lax.psum_scatter``.  Multi-host initialization goes through
+``jax.distributed.initialize`` (the analogue of ``Network::Init``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def make_mesh(num_devices: int = 0, axis_name: str = DATA_AXIS,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the given axis (rows for data-parallel, columns for
+    feature-parallel)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_devices and num_devices > 0:
+        devs = devs[:num_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def make_2d_mesh(data: int, feature: int) -> Mesh:
+    """data x feature mesh for combined row/column sharding (reserved for
+    the 2-D hybrid learner; not yet wired into the boosting layer)."""
+    devs = np.asarray(jax.devices()[:data * feature]).reshape(data, feature)
+    return Mesh(devs, (DATA_AXIS, FEATURE_AXIS))
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (Network::Init analogue; machine-list file →
+    coordinator address)."""
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+
+def pad_rows(n: int, shards: int) -> int:
+    """Rows padded so every shard gets an equal static slice."""
+    return (-n) % shards
+
+
+def pad_features(f: int, shards: int) -> int:
+    return (-f) % shards
