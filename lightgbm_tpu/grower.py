@@ -10,9 +10,11 @@ TPU-native re-design of ``SerialTreeLearner::Train``
   smaller-child + parent-subtraction trick;
 * the split loop is a ``lax.while_loop`` with all per-leaf state in fixed
   ``[num_leaves]`` arrays, so one compilation serves every tree;
-* distribution hooks in via ``reduce_hist`` (``lax.psum`` over the mesh) —
-  the data-parallel learner's ReduceScatter
-  (``data_parallel_tree_learner.cpp:148-163``) collapses to that one line.
+* distribution hooks in via a strategy object (``SerialStrategy`` here,
+  parallel variants in ``parallel.learner``) whose ``hist``/``find`` methods
+  insert XLA collectives — the data-parallel learner's ReduceScatter
+  (``data_parallel_tree_learner.cpp:148-163``) collapses to a ``psum``/
+  ``psum_scatter`` inside ``hist``.
 
 Output is a struct-of-arrays tree (same SoA layout as the reference ``Tree``,
 ``include/LightGBM/tree.h:20-370``) plus the final row→leaf map used for the
@@ -90,8 +92,8 @@ class SerialStrategy:
     ``lightgbm_tpu.parallel.learner``:
 
     * ``setup(bins, meta, feat_valid) -> ctx``  — per-shard views
-    * ``hist(ctx, seg, gw, hw, cw) -> [2, F', B, 3]`` — child histograms,
-      reduced across the mesh as the strategy requires
+    * ``hist(ctx, bins, seg, gw, hw, cw) -> [2, F', B, 3]`` — child
+      histograms, reduced across the mesh as the strategy requires
     * ``find(ctx, hist_child, pg, ph, pc) -> SplitResult`` — globally agreed
       best split (feature indices in the full/global numbering)
     * ``reduce_scalar(x)`` — global sums of row statistics

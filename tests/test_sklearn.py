@@ -1,0 +1,207 @@
+"""sklearn wrapper tests mirroring reference
+tests/python_package_test/test_sklearn.py:27-152."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _mse(a, b):
+    return float(np.mean((np.asarray(a) - np.asarray(b)) ** 2))
+
+
+def test_binary_classifier():
+    """test_sklearn.py:27 — breast_cancer, logloss threshold."""
+    from sklearn.datasets import load_breast_cancer
+    from sklearn.metrics import log_loss
+    from sklearn.model_selection import train_test_split
+    X, y = load_breast_cancer(return_X_y=True)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, test_size=0.1,
+                                              random_state=42)
+    clf = lgb.LGBMClassifier(n_estimators=50, silent=True)
+    clf.fit(X_tr, y_tr, eval_set=[(X_te, y_te)],
+            early_stopping_rounds=5, verbose=False)
+    proba = clf.predict_proba(X_te)
+    assert proba.shape == (len(y_te), 2)
+    assert log_loss(y_te, proba[:, 1]) < 0.15
+    assert set(np.unique(clf.predict(X_te))) <= set(np.unique(y))
+    assert clf.classes_.tolist() == [0, 1]
+    assert clf.n_classes_ == 2
+    assert clf.feature_importances_.shape[0] == X.shape[1]
+
+
+def test_regressor():
+    """test_sklearn.py:39 — boston-style regression, mse threshold."""
+    from sklearn.model_selection import train_test_split
+    rng = np.random.RandomState(2)
+    X = rng.randn(1000, 10)
+    y = X @ rng.randn(10) + 0.1 * rng.randn(1000)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, test_size=0.1,
+                                              random_state=42)
+    reg = lgb.LGBMRegressor(n_estimators=50, silent=True)
+    reg.fit(X_tr, y_tr, eval_set=[(X_te, y_te)],
+            early_stopping_rounds=5, verbose=False)
+    assert _mse(y_te, reg.predict(X_te)) < 1.0
+    assert reg.best_iteration_ > 0
+    assert reg.evals_result_ is not None
+
+
+def test_multiclass():
+    """test_sklearn.py:51 — iris-style multiclass."""
+    from sklearn.datasets import load_iris
+    from sklearn.model_selection import train_test_split
+    X, y = load_iris(return_X_y=True)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, test_size=0.2,
+                                              random_state=42)
+    clf = lgb.LGBMClassifier(n_estimators=30, silent=True)
+    clf.fit(X_tr, y_tr)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X_te)
+    assert proba.shape == (len(y_te), 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    acc = float(np.mean(clf.predict(X_te) == y_te))
+    assert acc > 0.9
+
+
+def test_ranker():
+    """test_sklearn.py:56 — lambdarank with group arrays."""
+    rng = np.random.RandomState(3)
+    n_queries, per_q = 50, 20
+    X = rng.rand(n_queries * per_q, 5)
+    rel = (X[:, 0] * 3).astype(np.int64)  # relevance driven by feature 0
+    group = np.full(n_queries, per_q)
+    rk = lgb.LGBMRanker(n_estimators=20, num_leaves=7, min_child_samples=5,
+                        silent=True)
+    rk.fit(X, rel, group=group)
+    pred = rk.predict(X)
+    # scores must correlate with relevance
+    assert np.corrcoef(pred, rel)[0, 1] > 0.5
+    with pytest.raises(ValueError):
+        lgb.LGBMRanker().fit(X, rel)  # group missing
+
+
+def test_custom_objective():
+    """test_sklearn.py:65-93 — callable objective hook."""
+    from sklearn.model_selection import train_test_split
+
+    def objective_ls(y_true, y_pred):
+        grad = y_pred - y_true
+        hess = np.ones_like(y_true)
+        return grad, hess
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(800, 8)
+    y = X @ rng.randn(8)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, test_size=0.1,
+                                              random_state=0)
+    reg = lgb.LGBMRegressor(n_estimators=30, objective=objective_ls,
+                            silent=True)
+    reg.fit(X_tr, y_tr)
+    assert _mse(y_te, reg.predict(X_te)) < 1.0
+
+
+def test_custom_eval_metric():
+    from sklearn.datasets import load_breast_cancer
+    from sklearn.model_selection import train_test_split
+
+    def neg_count_error(y_true, y_pred):
+        return "err_cnt", float(np.sum((y_pred > 0.5) != y_true)), False
+
+    X, y = load_breast_cancer(return_X_y=True)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, test_size=0.1,
+                                              random_state=42)
+    clf = lgb.LGBMClassifier(n_estimators=20, silent=True)
+    clf.fit(X_tr, y_tr, eval_set=[(X_te, y_te)], eval_metric=neg_count_error,
+            verbose=False)
+    assert "err_cnt" in clf.evals_result_["valid_0"]
+
+
+def test_dart_boosting_type():
+    """test_sklearn.py:94 — dart mode through the wrapper."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(500, 5)
+    y = X @ rng.randn(5)
+    reg = lgb.LGBMRegressor(boosting_type="dart", n_estimators=20,
+                            silent=True)
+    reg.fit(X, y)
+    assert _mse(y, reg.predict(X)) < 1.0
+
+
+def test_grid_search():
+    """test_sklearn.py:101 — GridSearchCV compatibility."""
+    from sklearn.model_selection import GridSearchCV
+    rng = np.random.RandomState(6)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.int64)
+    grid = {"num_leaves": [7, 15], "n_estimators": [10]}
+    gs = GridSearchCV(lgb.LGBMClassifier(silent=True), grid, cv=2)
+    gs.fit(X, y)
+    assert gs.best_params_["n_estimators"] == 10
+    assert gs.best_params_["num_leaves"] in (7, 15)
+
+
+def test_clone_and_pickle():
+    """test_sklearn.py:111-152 — clone() and joblib/pickle round-trip."""
+    import pickle
+
+    from sklearn.base import clone
+    rng = np.random.RandomState(7)
+    X = rng.randn(400, 6)
+    y = X @ rng.randn(6)
+    reg = lgb.LGBMRegressor(n_estimators=15, num_leaves=9, silent=True)
+    cl = clone(reg)
+    assert cl.get_params()["num_leaves"] == 9
+    reg.fit(X, y)
+    blob = pickle.dumps(reg)
+    reg2 = pickle.loads(blob)
+    np.testing.assert_allclose(reg.predict(X), reg2.predict(X), atol=1e-9)
+
+
+def test_refit_fewer_classes():
+    """Refitting a classifier on a different class count must not leak
+    num_class state from the previous fit."""
+    rng = np.random.RandomState(8)
+    X3 = rng.randn(300, 4)
+    y3 = rng.randint(0, 3, 300)
+    X2 = rng.randn(300, 4)
+    y2 = rng.randint(0, 2, 300)
+    clf = lgb.LGBMClassifier(n_estimators=5, silent=True)
+    clf.fit(X3, y3)
+    assert clf.n_classes_ == 3
+    clf.fit(X2, y2)
+    assert clf.n_classes_ == 2
+    assert clf.predict_proba(X2).shape == (300, 2)
+
+
+def test_objective_switch_after_set_params():
+    """A callable objective must not survive set_params to a string one."""
+    def obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    rng = np.random.RandomState(9)
+    X = rng.randn(200, 4)
+    y = X @ rng.randn(4)
+    reg = lgb.LGBMRegressor(n_estimators=5, objective=obj, silent=True)
+    reg.fit(X, y)
+    assert reg._fobj is not None
+    reg.set_params(objective="regression_l2")
+    reg.fit(X, y)
+    assert reg._fobj is None
+
+
+def test_sample_weight_positional():
+    rng = np.random.RandomState(10)
+    X = rng.randn(200, 4)
+    y = (X[:, 0] > 0).astype(np.int64)
+    w = np.ones(200)
+    clf = lgb.LGBMClassifier(n_estimators=5, silent=True)
+    clf.fit(X, y, w)  # positional sample_weight must bind correctly
+    assert clf.predict(X).shape == (200,)
+
+
+def test_set_params_kwargs_passthrough():
+    reg = lgb.LGBMRegressor(silent=True, min_data_in_leaf=5)
+    params = reg.get_params()
+    assert params["min_data_in_leaf"] == 5
+    reg.set_params(min_data_in_leaf=11)
+    assert reg.get_params()["min_data_in_leaf"] == 11
