@@ -106,7 +106,13 @@ class GBDT:
             min_gain_to_split=cfg.min_gain_to_split,
             max_bin=train.max_num_bin(),
             hist_method=("pallas" if cfg.use_pallas and _on_tpu() else "auto"),
-            rows_per_chunk=cfg.rows_per_chunk or 16384)
+            rows_per_chunk=cfg.rows_per_chunk or 16384,
+            has_categorical=bool(np.asarray(fm["is_categorical"]).any()),
+            max_cat_threshold=cfg.max_cat_threshold,
+            max_cat_group=cfg.max_cat_group,
+            cat_smooth_ratio=cfg.cat_smooth_ratio,
+            min_cat_smooth=cfg.min_cat_smooth,
+            max_cat_smooth=cfg.max_cat_smooth)
         self._setup_grower(cfg, train)
 
         self.objective.init(train.metadata, n)
@@ -117,10 +123,7 @@ class GBDT:
         if self._has_init_score:
             init = np.asarray(train.metadata.init_score, np.float32)
             self.scores = self.scores + init.reshape(self.num_class, n)
-        # categorical features need the sort-by-ratio scan + bitset thresholds
-        # (feature_histogram.hpp:104-223); until that lands they are excluded
-        # from splitting so training and serialized models stay consistent.
-        self._feat_valid_base = ~np.asarray(fm["is_categorical"])
+        self._feat_valid_base = np.ones(len(fm["is_categorical"]), dtype=bool)
         self._bag_weight = jnp.ones((n,), jnp.float32)
         self._bag_cnt = jnp.ones((n,), jnp.float32)
         self._bag_rng = make_rng(cfg.bagging_seed)
@@ -195,7 +198,8 @@ class GBDT:
             k = i % self.num_class
             vs.scores = vs.scores.at[k].add(
                 tree_scores_binned(vs.bins, tree, self.used_feature_index,
-                                   self.feat_info))
+                                   self.feat_info,
+                                   self.train_set.bin_mappers))
         self.valid_sets.append(vs)
 
     # --------------------------------------------------------------- training
@@ -288,7 +292,8 @@ class GBDT:
                     jnp.asarray(lr, jnp.float32)))
                 for vs in self.valid_sets:
                     vs.scores = vs.scores.at[k].add(tree_scores_binned(
-                        vs.bins, tree, self.used_feature_index, self.feat_info))
+                        vs.bins, tree, self.used_feature_index, self.feat_info,
+                        self.train_set.bin_mappers))
         self._after_iter()
         self.iter_ += 1
         if not any_split:
@@ -315,7 +320,7 @@ class GBDT:
     def _train_tree_score(self, tree: Tree) -> jnp.ndarray:
         """Per-row contribution of a tree on the (possibly padded) train bins."""
         s = tree_scores_binned(self.bins, tree, self.used_feature_index,
-                               self.feat_info)
+                               self.feat_info, self.train_set.bin_mappers)
         return s[:self.num_data] if self._row_pad else s
 
     def rollback_one_iter(self) -> None:
@@ -329,7 +334,8 @@ class GBDT:
                 self.scores = self.scores.at[k].add(self._train_tree_score(tree))
                 for vs in self.valid_sets:
                     vs.scores = vs.scores.at[k].add(tree_scores_binned(
-                        vs.bins, tree, self.used_feature_index, self.feat_info))
+                        vs.bins, tree, self.used_feature_index, self.feat_info,
+                        self.train_set.bin_mappers))
         self.iter_ -= 1
 
     # ------------------------------------------------------------------- eval
@@ -502,7 +508,7 @@ class DART(GBDT):
 
     def _tree_score(self, tree, bins):
         s = tree_scores_binned(bins, tree, self.used_feature_index,
-                               self.feat_info)
+                               self.feat_info, self.train_set.bin_mappers)
         if bins is self.bins and self._row_pad:
             s = s[:self.num_data]
         return s

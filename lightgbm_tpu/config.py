@@ -153,7 +153,8 @@ class Config:
     sparse_threshold: float = 0.8
     max_conflict_rate: float = 0.0
     is_pre_partition: bool = False
-    two_round: bool = False
+    use_two_round_loading: bool = False
+    is_save_binary_file: bool = False
     has_header: bool = False
     label_column: str = ""
     weight_column: str = ""

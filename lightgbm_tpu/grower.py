@@ -45,10 +45,19 @@ class GrowerConfig(NamedTuple):
     max_bin: int = 256               # B: histogram width (max over features)
     hist_method: str = "auto"        # onehot | segsum | pallas | auto
     rows_per_chunk: int = 16384
+    has_categorical: bool = False    # static: enables the categorical path
+    max_cat_threshold: int = 256
+    max_cat_group: int = 64
+    cat_smooth_ratio: float = 0.01
+    min_cat_smooth: float = 5.0
+    max_cat_smooth: float = 100.0
 
     def split_config(self) -> SplitConfig:
         return SplitConfig(self.lambda_l1, self.lambda_l2, self.min_gain_to_split,
-                           self.min_data_in_leaf, self.min_sum_hessian_in_leaf)
+                           self.min_data_in_leaf, self.min_sum_hessian_in_leaf,
+                           self.has_categorical, self.max_cat_threshold,
+                           self.max_cat_group, self.cat_smooth_ratio,
+                           self.min_cat_smooth, self.max_cat_smooth)
 
 
 class TreeArrays(NamedTuple):
@@ -66,6 +75,8 @@ class TreeArrays(NamedTuple):
     leaf_count: jnp.ndarray       # [L] f32
     leaf_parent: jnp.ndarray      # [L] i32
     leaf_depth: jnp.ndarray       # [L] i32
+    is_cat: jnp.ndarray           # [L-1] bool: categorical decision node
+    cat_bins: jnp.ndarray         # [L-1, B] bool: bins routed left
 
 
 class FeatureMeta(NamedTuple):
@@ -114,7 +125,7 @@ class SerialStrategy:
         meta, feat_valid = ctx
         return best_split(hist_child, pg, ph, pc, meta.num_bin,
                           meta.missing_type, meta.default_bin, feat_valid,
-                          self.cfg.split_config())
+                          self.cfg.split_config(), is_cat=meta.is_categorical)
 
     def reduce_scalar(self, x):
         return x
@@ -195,6 +206,8 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             leaf_count=_set(jnp.zeros((L,), dtype), 0, root_c),
             leaf_parent=jnp.full((L,), -1, jnp.int32),
             leaf_depth=jnp.zeros((L,), jnp.int32),
+            is_cat=jnp.zeros((L - 1,), bool),
+            cat_bins=jnp.zeros((L - 1, cfg.max_bin), bool),
         )
 
         def cond(state: _LoopState):
@@ -222,6 +235,11 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             is_missing = (((mt_f == MISSING_NAN) & (binf == nb_f - 1))
                           | ((mt_f == MISSING_ZERO) & (binf == db_f)))
             goes_left = jnp.where(is_missing, dleft, binf <= thr)
+            # categorical node: route by bin membership in the chosen set
+            # (CategoricalDecisionInner, tree.h:285-293)
+            cat_go_left = splits.cat_bins[l][
+                jnp.clip(binf, 0, cfg.max_bin - 1)]
+            goes_left = jnp.where(splits.is_cat[l], cat_go_left, goes_left)
             in_leaf = state.row_leaf == l
             row_leaf = jnp.where(in_leaf & ~goes_left, new_leaf, state.row_leaf)
 
@@ -260,6 +278,8 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
                 leaf_parent=_set(_set(tree.leaf_parent, l, node), new_leaf, node),
                 leaf_depth=_set(_set(tree.leaf_depth, l, child_depth),
                                 new_leaf, child_depth),
+                is_cat=_set(tree.is_cat, node, splits.is_cat[l]),
+                cat_bins=tree.cat_bins.at[node].set(splits.cat_bins[l]),
             )
 
             # --- histograms + best splits for both children in one sweep -----

@@ -35,6 +35,13 @@ class SplitConfig(NamedTuple):
     min_gain_to_split: float = 0.0
     min_data_in_leaf: int = 20
     min_sum_hessian_in_leaf: float = 1e-3
+    # categorical split search (feature_histogram.hpp:104-223)
+    has_categorical: bool = False   # static: skip the cat path entirely if off
+    max_cat_threshold: int = 256
+    max_cat_group: int = 64
+    cat_smooth_ratio: float = 0.01
+    min_cat_smooth: float = 5.0
+    max_cat_smooth: float = 100.0
 
 
 class SplitResult(NamedTuple):
@@ -53,6 +60,8 @@ class SplitResult(NamedTuple):
     right_count: jnp.ndarray
     left_output: jnp.ndarray
     right_output: jnp.ndarray
+    is_cat: jnp.ndarray       # bool: categorical split (bitset, not threshold)
+    cat_bins: jnp.ndarray     # [B] bool: bins routed LEFT (cat splits only)
 
 
 def leaf_split_gain(sum_g, sum_h, l1, l2):
@@ -157,6 +166,155 @@ def _candidate_arrays(hist, parent_g, parent_h, parent_c,
     return gains, lg, lh, lc, thr, is_m1, min_gain_shift, tot_h, l1, l2
 
 
+def _categorical_candidates(hist, parent_g, parent_h, parent_c,
+                            num_bin, is_cat, feat_valid, missing_type,
+                            cfg: SplitConfig):
+    """Categorical split candidates (FindBestThresholdCategorical,
+    feature_histogram.hpp:104-223), vectorized over features.
+
+    Bins of each categorical feature are sorted by smoothed grad/hess ratio;
+    candidates are prefixes of the sorted order (dir=+1) and of the reversed
+    order (dir=-1), up to ``max_cat_threshold`` positions, gated by the
+    ``max_cat_group`` accounting which is a short ``lax.scan``.
+
+    Returns (gains [F, 2T], lg, lh, lc, pos [F, 2T], is_p1 [F, 2T],
+    order [F, B], used_bin [F]) with candidate order: dir=+1 ascending i,
+    then dir=-1 ascending i (the reference's dirs = {1, -1} loop).
+    """
+    dtype = hist.dtype
+    f, b, _ = hist.shape
+    T = min(int(cfg.max_cat_threshold), b)
+    g = hist[:, :, 0]
+    h = hist[:, :, 1]
+    c = hist[:, :, 2]
+    nb = num_bin                                  # [F]
+    # used_bin = num_bin - 1 + (missing == None): the overflow/NaN bin is
+    # excluded from the scan unless the mapper saw every category
+    used_bin = nb - 1 + (missing_type == MISSING_NONE).astype(jnp.int32)
+
+    l1 = jnp.asarray(cfg.lambda_l1, dtype)
+    l2 = jnp.asarray(cfg.lambda_l2, dtype)
+    min_data = jnp.asarray(cfg.min_data_in_leaf, dtype)
+    min_hess = jnp.asarray(cfg.min_sum_hessian_in_leaf, dtype)
+
+    pg = jnp.broadcast_to(jnp.asarray(parent_g, dtype), (f, 1))[:, 0] \
+        if jnp.ndim(parent_g) else jnp.full((f,), parent_g, dtype)
+    ph = jnp.broadcast_to(jnp.asarray(parent_h, dtype), (f, 1))[:, 0] \
+        if jnp.ndim(parent_h) else jnp.full((f,), parent_h, dtype)
+    pc = jnp.broadcast_to(jnp.asarray(parent_c, dtype), (f, 1))[:, 0] \
+        if jnp.ndim(parent_c) else jnp.full((f,), parent_c, dtype)
+    tot_h = ph + 2.0 * K_EPSILON
+    gain_shift = leaf_split_gain(pg, tot_h, l1, l2)
+    min_gain_shift = gain_shift + cfg.min_gain_to_split      # [F]
+
+    # smoothing (feature_histogram.hpp:122-126)
+    smooth_hess = jnp.minimum(
+        cfg.max_cat_smooth,
+        jnp.maximum(cfg.cat_smooth_ratio * pc / jnp.maximum(nb, 1),
+                    cfg.min_cat_smooth))
+    smooth_grad = smooth_hess * pg / jnp.where(ph == 0, 1.0, ph)
+
+    bins_iota = lax.broadcasted_iota(jnp.int32, (f, b), 1)
+    in_scan = bins_iota < used_bin[:, None]
+    key = (g + smooth_grad[:, None]) / (h + smooth_hess[:, None])
+    key = jnp.where(in_scan, key, jnp.inf)        # invalid bins sort last
+    order = jnp.argsort(key, axis=1)              # [F, B] bin ids, ascending
+
+    sg = jnp.take_along_axis(g, order, axis=1)
+    sh = jnp.take_along_axis(h, order, axis=1)
+    sc = jnp.take_along_axis(c, order, axis=1)
+    csg = jnp.cumsum(sg, axis=1)
+    csh = jnp.cumsum(sh, axis=1)
+    csc = jnp.cumsum(sc, axis=1)
+    last = jnp.clip(used_bin - 1, 0, b - 1)[:, None]
+    tg = jnp.take_along_axis(csg, last, axis=1)[:, 0]
+    th_ = jnp.take_along_axis(csh, last, axis=1)[:, 0]
+    tc = jnp.take_along_axis(csc, last, axis=1)[:, 0]
+
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]            # [1, T]
+    # dir=+1: prefix of the sorted order
+    take_p1 = jnp.minimum(pos, b - 1)
+    lg_p1 = jnp.take_along_axis(csg, take_p1, axis=1)
+    lh_p1 = jnp.take_along_axis(csh, take_p1, axis=1)
+    lc_p1 = jnp.take_along_axis(csc, take_p1, axis=1)
+    csc_sorted_c = jnp.take_along_axis(sc, take_p1, axis=1)  # step counts
+    # dir=-1: prefix of the reversed order = totals minus cumsum at ub-2-i
+    idx_m1 = used_bin[:, None] - 2 - pos                     # may be < 0
+    clip_m1 = jnp.clip(idx_m1, 0, b - 1)
+    pre_g = jnp.where(idx_m1 >= 0, jnp.take_along_axis(csg, clip_m1, axis=1), 0.0)
+    pre_h = jnp.where(idx_m1 >= 0, jnp.take_along_axis(csh, clip_m1, axis=1), 0.0)
+    pre_c = jnp.where(idx_m1 >= 0, jnp.take_along_axis(csc, clip_m1, axis=1), 0.0)
+    lg_m1 = tg[:, None] - pre_g
+    lh_m1 = th_[:, None] - pre_h
+    lc_m1 = tc[:, None] - pre_c
+    step_m1 = jnp.clip(used_bin[:, None] - 1 - pos, 0, b - 1)
+    sc_m1 = jnp.take_along_axis(sc, step_m1, axis=1)
+
+    # dir=-1 skipped when full-categorical and 2*max_cat_threshold covers all
+    # bins (feature_histogram.hpp:134-138)
+    dir_m1_on = ~((missing_type == MISSING_NONE)
+                  & (2 * cfg.max_cat_threshold >= nb))
+
+    cat_ok = feat_valid & is_cat                             # [F]
+    base_valid = cat_ok[:, None] & (pos < used_bin[:, None]) # [F, T]
+
+    def stack2(p1, m1):                                      # → [F, 2, T]
+        return jnp.stack([p1, m1], axis=1)
+
+    lg2 = stack2(lg_p1, lg_m1)
+    lh2 = stack2(lh_p1, lh_m1) + K_EPSILON
+    lc2 = stack2(lc_p1, lc_m1)
+    step_c = stack2(csc_sorted_c, sc_m1)
+    valid2 = stack2(base_valid, base_valid & dir_m1_on[:, None])
+
+    rg2 = pg[:, None, None] - lg2
+    rh2 = tot_h[:, None, None] - lh2
+    rc2 = pc[:, None, None] - lc2
+    cont_ok = (lc2 >= min_data) & (lh2 >= min_hess)
+    right_ok = (rc2 >= min_data) & (rh2 >= min_hess)
+
+    # max_cat_group gating: sequential accounting over candidate positions
+    # (feature_histogram.hpp:142-147,169-177) — a T-step scan over [F, 2]
+    rest0 = jnp.full((f, 2), cfg.max_cat_group, dtype)
+    mdpg0 = jnp.maximum(1.0, jnp.floor(pc / cfg.max_cat_group))[:, None] \
+        * jnp.ones((1, 2), dtype)
+    cnt0 = jnp.zeros((f, 2), dtype)
+
+    def group_step(state, xs):
+        cnt, rest, mdpg = state
+        step_cnt, cont, rok, rcnt = xs
+        cnt = cnt + step_cnt
+        accept = cont & rok & (cnt >= mdpg)
+        new_rest = jnp.where(accept, rest - 1.0, rest)
+        new_mdpg = jnp.where(
+            accept & (new_rest > 0),
+            jnp.maximum(1.0, jnp.floor(rcnt / jnp.maximum(new_rest, 1.0))),
+            mdpg)
+        new_cnt = jnp.where(accept, 0.0, cnt)
+        return (new_cnt, new_rest, new_mdpg), accept
+
+    xs = (jnp.moveaxis(step_c, 2, 0), jnp.moveaxis(cont_ok, 2, 0),
+          jnp.moveaxis(right_ok, 2, 0), jnp.moveaxis(rc2, 2, 0))
+    _, accepts = lax.scan(group_step, (cnt0, rest0, mdpg0), xs)
+    accept2 = jnp.moveaxis(accepts, 0, 2)                    # [F, 2, T]
+
+    gain2 = (leaf_split_gain(lg2, lh2, l1, l2)
+             + leaf_split_gain(rg2, rh2, l1, l2))
+    ok = valid2 & cont_ok & right_ok & accept2 \
+        & (gain2 > min_gain_shift[:, None, None])
+    gain2 = jnp.where(ok, gain2, -jnp.inf)
+
+    def flat(a):                                             # [F, 2, T] → [F, 2T]
+        return a.reshape(f, 2 * T)
+
+    pos2 = jnp.broadcast_to(pos[None, :, :], (f, 2, T))
+    is_p1 = jnp.broadcast_to(
+        jnp.asarray([True, False])[None, :, None], (f, 2, T))
+    return (flat(gain2), flat(lg2), flat(lh2), flat(lc2),
+            flat(pos2), flat(is_p1), order, used_bin, min_gain_shift, tot_h,
+            l1, l2)
+
+
 def _result_from_index(idx, gains_flat, lg, lh, lc, thr, is_m1,
                        parent_g, parent_c, num_bin, missing_type,
                        min_gain_shift, tot_h, l1, l2, nf, b, feature_base=0):
@@ -194,6 +352,60 @@ def _result_from_index(idx, gains_flat, lg, lh, lc, thr, is_m1,
         right_count=right_count,
         left_output=leaf_output(left_sum_g, left_sum_h_raw, l1, l2),
         right_output=leaf_output(right_sum_g, right_sum_h_raw, l1, l2),
+        is_cat=jnp.zeros((), bool),
+        cat_bins=jnp.zeros((b,), bool),
+    )
+
+
+def _cat_result_from_index(idx, gains_flat, lg, lh, lc, pos, is_p1,
+                           order, used_bin, parent_g, parent_c,
+                           min_gain_shift, tot_h, l1, l2, nf, b, t2,
+                           feature_base=0) -> SplitResult:
+    """Assemble a categorical SplitResult from a flat index into [F, 2T]."""
+    neg_inf = jnp.asarray(-jnp.inf, gains_flat.dtype)
+    best_gain = gains_flat[idx]
+    found = best_gain > neg_inf
+    feature_local = (idx // t2).astype(jnp.int32)
+    fi = jnp.clip(feature_local, 0, nf - 1)
+    p = pos.reshape(-1)[idx]
+    p1 = is_p1.reshape(-1)[idx]
+    ub = used_bin[fi]
+
+    # bins routed left = sorted positions [0..p] (dir=+1) or
+    # [ub-1-p..ub-1] (dir=-1); rank = inverse permutation of the sort
+    order_row = lax.dynamic_index_in_dim(order, fi, axis=0, keepdims=False)
+    rank = jnp.argsort(order_row)                 # rank[bin] = sorted position
+    member = jnp.where(p1, rank <= p, rank >= ub - 1 - p) & (rank < ub)
+    cat_bins = found & member
+
+    shift = min_gain_shift[fi] if jnp.ndim(min_gain_shift) else min_gain_shift
+    toth = tot_h[fi] if jnp.ndim(tot_h) else tot_h
+    pg = parent_g[fi] if jnp.ndim(parent_g) else parent_g
+    pc = parent_c[fi] if jnp.ndim(parent_c) else parent_c
+
+    left_sum_g = lg.reshape(-1)[idx]
+    left_sum_h_raw = lh.reshape(-1)[idx]
+    left_count = lc.reshape(-1)[idx]
+    right_sum_g = pg - left_sum_g
+    right_sum_h_raw = toth - left_sum_h_raw
+    right_count = pc - left_count
+
+    return SplitResult(
+        found=found,
+        gain=jnp.where(found, best_gain - shift, neg_inf),
+        feature=jnp.where(found, fi + feature_base, -1),
+        threshold=jnp.zeros((), jnp.int32),
+        default_left=jnp.zeros((), bool),        # cat splits default right
+        left_sum_g=left_sum_g,
+        left_sum_h=left_sum_h_raw - K_EPSILON,
+        left_count=left_count,
+        right_sum_g=right_sum_g,
+        right_sum_h=right_sum_h_raw - K_EPSILON,
+        right_count=right_count,
+        left_output=leaf_output(left_sum_g, left_sum_h_raw, l1, l2),
+        right_output=leaf_output(right_sum_g, right_sum_h_raw, l1, l2),
+        is_cat=found,
+        cat_bins=cat_bins,
     )
 
 
@@ -201,41 +413,77 @@ def best_split(hist: jnp.ndarray,
                parent_g: jnp.ndarray, parent_h: jnp.ndarray, parent_c: jnp.ndarray,
                num_bin: jnp.ndarray, missing_type: jnp.ndarray,
                default_bin: jnp.ndarray, feat_valid: jnp.ndarray,
-               cfg: SplitConfig, feature_base: int = 0) -> SplitResult:
-    """Best numerical split across all features of one leaf.
+               cfg: SplitConfig, feature_base: int = 0,
+               is_cat: jnp.ndarray = None) -> SplitResult:
+    """Best split (numerical or categorical) across all features of one leaf.
 
     hist: [F, B, 3] (sum_g, sum_h, count); num_bin/missing_type/default_bin:
-    [F] i32; feat_valid: [F] bool (feature_fraction & non-trivial &
-    non-categorical).  parent_*: scalars for the leaf.  ``feature_base``
-    offsets the reported feature index (feature-parallel shards).
+    [F] i32; feat_valid: [F] bool (feature_fraction & non-trivial); is_cat:
+    [F] bool (None ⇒ all numerical).  parent_*: scalars for the leaf.
+    ``feature_base`` offsets the reported feature index (feature-parallel
+    shards).
     """
     f, b, _ = hist.shape
+    use_cat = cfg.has_categorical and is_cat is not None
+    num_valid = feat_valid & ~is_cat if use_cat else feat_valid
     (gains, lg, lh, lc, thr, is_m1,
      min_gain_shift, tot_h, l1, l2) = _candidate_arrays(
         hist, parent_g, parent_h, parent_c, num_bin, missing_type,
-        default_bin, feat_valid, cfg)
+        default_bin, num_valid, cfg)
     flat = gains.reshape(-1)
     idx = jnp.argmax(flat)
-    return _result_from_index(idx, flat, lg, lh, lc, thr, is_m1,
-                              parent_g, parent_c, num_bin, missing_type,
-                              min_gain_shift, tot_h, l1, l2, f, b,
-                              feature_base)
+    num_res = _result_from_index(idx, flat, lg, lh, lc, thr, is_m1,
+                                 parent_g, parent_c, num_bin, missing_type,
+                                 min_gain_shift, tot_h, l1, l2, f, b,
+                                 feature_base)
+    if not use_cat:
+        return num_res
+
+    (cgains, clg, clh, clc, cpos, cp1, order, used_bin,
+     c_shift, c_tot_h, _, _) = _categorical_candidates(
+        hist, parent_g, parent_h, parent_c, num_bin, is_cat, feat_valid,
+        missing_type, cfg)
+    cflat = cgains.reshape(-1)
+    cidx = jnp.argmax(cflat)
+    cat_res = _cat_result_from_index(cidx, cflat, clg, clh, clc, cpos, cp1,
+                                     order, used_bin, parent_g, parent_c,
+                                     c_shift, c_tot_h, l1, l2, f, b,
+                                     cgains.shape[1], feature_base)
+    # features are either numerical or categorical; reproduce the serial
+    # learner's feature-major tie-break (smallest feature index wins)
+    pick_cat = cat_res.found & (~num_res.found
+                                | (cat_res.gain > num_res.gain)
+                                | ((cat_res.gain == num_res.gain)
+                                   & (cat_res.feature < num_res.feature)))
+    return jax.tree.map(lambda a, c: jnp.where(pick_cat, c, a),
+                        num_res, cat_res)
 
 
 def per_feature_best_gain(hist: jnp.ndarray,
                           parent_g, parent_h, parent_c,
                           num_bin, missing_type, default_bin, feat_valid,
-                          cfg: SplitConfig) -> jnp.ndarray:
+                          cfg: SplitConfig, is_cat: jnp.ndarray = None) -> jnp.ndarray:
     """Best gain per feature [F] (gain - gain_shift; -inf if unsplittable).
 
     Used by the voting-parallel learner to pick each worker's top-k vote
     features (voting_parallel_tree_learner.cpp:255-330)."""
+    use_cat = cfg.has_categorical and is_cat is not None
+    num_valid = feat_valid & ~is_cat if use_cat else feat_valid
     (gains, _, _, _, _, _, min_gain_shift, _, _, _) = _candidate_arrays(
         hist, parent_g, parent_h, parent_c, num_bin, missing_type,
-        default_bin, feat_valid, cfg)
+        default_bin, num_valid, cfg)
     best = jnp.max(gains, axis=1)
     # parent sums may be per-feature [F, 1] (voting learner's local stats)
     shift = jnp.asarray(min_gain_shift)
     if shift.ndim:
         shift = shift.reshape(-1)
-    return jnp.where(best > -jnp.inf, best - shift, -jnp.inf)
+    out = jnp.where(best > -jnp.inf, best - shift, -jnp.inf)
+    if use_cat:
+        (cgains, _, _, _, _, _, _, _, c_shift, _, _, _) = \
+            _categorical_candidates(hist, parent_g, parent_h, parent_c,
+                                    num_bin, is_cat, feat_valid,
+                                    missing_type, cfg)
+        cbest = jnp.max(cgains, axis=1)
+        cout = jnp.where(cbest > -jnp.inf, cbest - c_shift, -jnp.inf)
+        out = jnp.maximum(out, cout)
+    return out

@@ -119,7 +119,8 @@ class FeatureParallelStrategy(SerialStrategy):
         res = best_split(hist_child, pg, ph, pc, meta_local.num_bin,
                          meta_local.missing_type, meta_local.default_bin,
                          fv_local, self.cfg.split_config(),
-                         feature_base=start)
+                         feature_base=start,
+                         is_cat=meta_local.is_categorical)
         return _broadcast_from_winner(res, self.axis)
 
 
@@ -159,7 +160,8 @@ class VotingStrategy(SerialStrategy):
         pc_loc = hist_child[:, :, 2].sum(axis=1, keepdims=True)
         local_gain = per_feature_best_gain(
             hist_child, pg_loc, ph_loc, pc_loc, meta.num_bin,
-            meta.missing_type, meta.default_bin, feat_valid, scfg)
+            meta.missing_type, meta.default_bin, feat_valid, scfg,
+            is_cat=meta.is_categorical)
         _, local_top = lax.top_k(local_gain, k)
         gathered = lax.all_gather(
             jnp.stack([local_gain[local_top],
@@ -174,7 +176,8 @@ class VotingStrategy(SerialStrategy):
         hist_sel = lax.psum(hist_child[sel], self.axis)  # [2k, B, 3]
         res = best_split(hist_sel, pg, ph, pc, meta.num_bin[sel],
                          meta.missing_type[sel], meta.default_bin[sel],
-                         feat_valid[sel], scfg)
+                         feat_valid[sel], scfg,
+                         is_cat=meta.is_categorical[sel])
         res = res._replace(feature=jnp.where(res.found, sel[jnp.clip(
             res.feature, 0, sel.shape[0] - 1)], -1))
         return res

@@ -33,9 +33,12 @@ def predict_binned_leaf(bins: jnp.ndarray,          # [N, F] int
                         default_left: jnp.ndarray,   # [P] bool
                         left_child: jnp.ndarray,     # [P] i32
                         right_child: jnp.ndarray,    # [P] i32
-                        feat_info: jnp.ndarray       # [F, 3]: num_bin, missing, default_bin
+                        feat_info: jnp.ndarray,      # [F, 3]: num_bin, missing, default_bin
+                        is_cat: jnp.ndarray,         # [P] bool
+                        cat_mask: jnp.ndarray        # [P, W] bool (W=1 if no cat)
                         ) -> jnp.ndarray:
-    """Return leaf index [N] for each row (NumericalDecisionInner semantics).
+    """Return leaf index [N] for each row (Numerical/CategoricalDecisionInner
+    semantics, tree.h:257-313).
 
     Node arrays are padded to a bucketed length P so jit compiles once per
     size bucket, not per tree.  Padding nodes must have child pointers < 0.
@@ -59,6 +62,8 @@ def predict_binned_leaf(bins: jnp.ndarray,          # [N, F] int
         is_missing = (((mt == MISSING_NAN) & (b == nb - 1))
                       | ((mt == MISSING_ZERO) & (b == db)))
         go_left = jnp.where(is_missing, default_left[nd], b <= threshold_bin[nd])
+        cat_left = cat_mask[nd, jnp.clip(b, 0, cat_mask.shape[1] - 1)]
+        go_left = jnp.where(is_cat[nd], cat_left, go_left)
         nxt = jnp.where(go_left, left_child[nd], right_child[nd])
         active = node >= 0
         new_node = jnp.where(active, nxt, node)
@@ -71,13 +76,23 @@ def predict_binned_leaf(bins: jnp.ndarray,          # [N, F] int
 
 
 def tree_scores_binned(bins: jnp.ndarray, tree: Tree, used_feature_index,
-                       feat_info: jnp.ndarray) -> jnp.ndarray:
-    """Per-row output of one host tree evaluated on binned data [N]."""
+                       feat_info: jnp.ndarray,
+                       bin_mappers=None) -> jnp.ndarray:
+    """Per-row output of one host tree evaluated on binned data [N].
+
+    ``bin_mappers`` (per original feature) is required only for trees with
+    categorical nodes, to translate value bitsets into bin masks.
+    """
     n = bins.shape[0]
     nn = tree.num_leaves - 1
     if nn <= 0:
         val = tree.leaf_value[0] if len(tree.leaf_value) else 0.0
         return jnp.full((n,), float(val), jnp.float32)
+    if not getattr(tree, "_binned_ok", False):
+        if bin_mappers is None:
+            log.fatal("bin_mappers required to predict a deserialized tree "
+                      "on binned data")
+        tree.ensure_binned(bin_mappers)
     # pad node arrays to a power-of-two bucket: bounded set of jit signatures
     p = 1
     while p < nn:
@@ -87,6 +102,18 @@ def tree_scores_binned(bins: jnp.ndarray, tree: Tree, used_feature_index,
                                np.full(p - nn, fill, dtype=np.asarray(a).dtype)])
     inner = np.asarray([used_feature_index[f] for f in tree.split_feature[:nn]],
                        dtype=np.int32)
+    is_cat = (tree.decision_type[:nn] & 1) > 0
+    if tree.num_cat > 0 and is_cat.any():
+        if bin_mappers is None:
+            log.fatal("bin_mappers required to predict a categorical tree "
+                      "on binned data")
+        width = int(np.asarray(feat_info[:, 0]).max())
+        cat_mask = np.zeros((p, width), dtype=bool)
+        for i in np.nonzero(is_cat)[0]:
+            cat_mask[i] = tree.cat_bin_mask(
+                int(i), bin_mappers[tree.split_feature[i]], width)
+    else:
+        cat_mask = np.zeros((p, 1), dtype=bool)
     leaf = predict_binned_leaf(
         bins,
         jnp.asarray(pad(inner)),
@@ -94,7 +121,9 @@ def tree_scores_binned(bins: jnp.ndarray, tree: Tree, used_feature_index,
         jnp.asarray(pad((tree.decision_type[:nn] & 2) > 0, False)),
         jnp.asarray(pad(tree.left_child, -1)),
         jnp.asarray(pad(tree.right_child, -1)),
-        feat_info)
+        feat_info,
+        jnp.asarray(pad(is_cat, False)),
+        jnp.asarray(cat_mask))
     return jnp.asarray(tree.leaf_value, jnp.float32)[leaf]
 
 

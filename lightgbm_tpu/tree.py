@@ -48,6 +48,10 @@ class Tree:
         self.cat_boundaries = np.zeros(1, dtype=np.int32)
         self.cat_threshold = np.zeros(0, dtype=np.uint32)
         self.shrinkage = 1.0
+        # True when threshold_bin matches the real thresholds under some
+        # dataset's bin mappers (set by from_arrays; reconstructed lazily for
+        # deserialized trees via ensure_binned)
+        self._binned_ok = False
 
     # ------------------------------------------------------------------ build
 
@@ -68,7 +72,7 @@ class Tree:
         inner_feat = np.asarray(arrays.split_feature[:n], dtype=np.int32)
         t.split_feature = np.asarray([used_features[i] for i in inner_feat],
                                      dtype=np.int32)
-        t.threshold_bin = np.asarray(arrays.threshold_bin[:n], dtype=np.int32)
+        t.threshold_bin = np.array(arrays.threshold_bin[:n], dtype=np.int32)
         t.split_gain = np.asarray(arrays.split_gain[:n], dtype=np.float64)
         t.left_child = np.asarray(arrays.left_child[:n], dtype=np.int32)
         t.right_child = np.asarray(arrays.right_child[:n], dtype=np.int32)
@@ -79,19 +83,60 @@ class Tree:
         t.internal_count = np.asarray(np.round(arrays.internal_count[:n]),
                                       dtype=np.int64)
         default_left = np.asarray(arrays.default_left[:n], dtype=bool)
+        is_cat = (np.asarray(arrays.is_cat[:n], dtype=bool)
+                  if hasattr(arrays, "is_cat") else np.zeros(n, dtype=bool))
+        cat_bins = (np.asarray(arrays.cat_bins[:n], dtype=bool)
+                    if hasattr(arrays, "cat_bins") else None)
         thresholds = np.zeros(n, dtype=np.float64)
         dtypes = np.zeros(n, dtype=np.int8)
+        cat_boundaries = [0]
+        cat_threshold: List[int] = []
         for i in range(n):
             mapper = bin_mappers[t.split_feature[i]]
-            thresholds[i] = mapper.bin_to_value(int(t.threshold_bin[i]))
-            dt = 0
-            if default_left[i]:
-                dt |= K_DEFAULT_LEFT_MASK
+            if is_cat[i]:
+                # Tree::SplitCategorical (tree.h:347-370): bitset over the
+                # raw category values of the bins routed left
+                cats = [mapper.bin_2_categorical[b]
+                        for b in np.nonzero(cat_bins[i][:mapper.num_bin])[0]]
+                size = (max(cats) // 32 + 1) if cats else 1
+                bs = np.zeros(size, dtype=np.uint32)
+                for cval in cats:
+                    bs[cval // 32] |= np.uint32(1 << (cval % 32))
+                thresholds[i] = float(t.num_cat)
+                t.threshold_bin[i] = t.num_cat
+                cat_threshold.extend(int(v) for v in bs)
+                cat_boundaries.append(len(cat_threshold))
+                t.num_cat += 1
+                dt = K_CATEGORICAL_MASK
+            else:
+                thresholds[i] = mapper.bin_to_value(int(t.threshold_bin[i]))
+                dt = 0
+                if default_left[i]:
+                    dt |= K_DEFAULT_LEFT_MASK
             dt |= (mapper.missing_type & 3) << 2
             dtypes[i] = dt
         t.threshold = thresholds
         t.decision_type = dtypes
+        if t.num_cat > 0:
+            t.cat_boundaries = np.asarray(cat_boundaries, dtype=np.int32)
+            t.cat_threshold = np.asarray(cat_threshold, dtype=np.uint32)
+        t._binned_ok = True
         return t
+
+    def ensure_binned(self, bin_mappers) -> None:
+        """Reconstruct ``threshold_bin`` from the real-valued thresholds for a
+        deserialized tree so binned (device) prediction works — needed when a
+        loaded model is replayed onto a Dataset (continued training)."""
+        if self._binned_ok or self.num_leaves <= 1:
+            return
+        for i in range(self.num_leaves - 1):
+            if self.is_categorical(i):
+                self.threshold_bin[i] = int(self.threshold[i])
+            else:
+                mapper = bin_mappers[self.split_feature[i]]
+                self.threshold_bin[i] = mapper.value_to_bin_scalar(
+                    self.threshold[i])
+        self._binned_ok = True
 
     # ---------------------------------------------------------------- helpers
 
@@ -113,6 +158,17 @@ class Tree:
     def cat_bitset(self, node: int) -> np.ndarray:
         ci = int(self.threshold[node])
         return self.cat_threshold[self.cat_boundaries[ci]:self.cat_boundaries[ci + 1]]
+
+    def cat_bin_mask(self, node: int, mapper, width: int) -> np.ndarray:
+        """bool[width]: which *bins* of the split feature route left at a
+        categorical node (inverse of the value bitset, for binned predict)."""
+        mask = np.zeros(width, dtype=bool)
+        bs = self.cat_bitset(node)
+        for b, cval in enumerate(mapper.bin_2_categorical or []):
+            i1, i2 = cval // 32, cval % 32
+            if i1 < len(bs) and (int(bs[i1]) >> i2) & 1:
+                mask[b] = True
+        return mask
 
     # ---------------------------------------------------------------- predict
 
