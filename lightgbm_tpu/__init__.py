@@ -12,6 +12,8 @@ from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .engine import cv, train
+from .plotting import (create_tree_digraph, plot_importance, plot_metric,
+                       plot_tree)
 
 __version__ = "0.1.0"
 
@@ -19,6 +21,7 @@ __all__ = [
     "Booster", "Dataset", "Config", "train", "cv",
     "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException",
+    "plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",
 ]
 
 try:  # sklearn API is optional at import time

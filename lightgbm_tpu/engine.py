@@ -87,6 +87,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
+    snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
+
     for i in range(num_boost_round):
         for cb in cbs_before:
             cb(callback_mod.CallbackEnv(model=booster, params=params,
@@ -94,6 +97,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                         end_iteration=num_boost_round,
                                         evaluation_result_list=None))
         finished = booster.update(fobj=fobj)
+
+        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+            # gbdt.cpp:456-460: periodic model snapshots during training
+            booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
 
         evaluation_result_list = []
         if valid_sets:

@@ -1,0 +1,71 @@
+"""Plotting tests mirroring the reference tests/python_package_test/test_plotting.py:
+importance / metric / tree-digraph render."""
+import os
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained(binary_example):
+    X, y, Xt, yt = binary_example
+    train_data = lgb.Dataset(X, label=y,
+                             feature_name=[f"f{i}" for i in range(X.shape[1])])
+    valid = train_data.create_valid(Xt, label=yt)
+    evals_result = {}
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "num_leaves": 7, "verbose": -1},
+                    train_data, num_boost_round=10, valid_sets=[valid],
+                    evals_result=evals_result, verbose_eval=False)
+    return bst, evals_result
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert ax is not None
+    assert ax.get_title() == "Feature importance"
+    ax2 = lgb.plot_importance(bst, max_num_features=5, importance_type="gain",
+                              title="t", xlabel="x", ylabel="y")
+    assert len(ax2.patches) <= 5
+
+
+def test_plot_metric(trained):
+    _, evals_result = trained
+    ax = lgb.plot_metric(evals_result)
+    assert ax is not None
+    assert ax.get_xlabel() == "Iterations"
+    with pytest.raises(ValueError):
+        lgb.plot_metric({})
+
+
+def test_create_tree_digraph(trained):
+    graphviz = pytest.importorskip("graphviz")  # noqa: F841
+    bst, _ = trained
+    graph = lgb.create_tree_digraph(bst, tree_index=0,
+                                    show_info=["split_gain", "leaf_count"])
+    src = graph.source
+    assert "split_feature_name" in src
+    assert "leaf_value" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(bst, tree_index=10**6)
+
+
+def test_snapshot_saving(tmp_path, binary_example):
+    """snapshot_freq saves intermediate models (gbdt.cpp:456-460)."""
+    X, y, _, _ = binary_example
+    out = tmp_path / "model.txt"
+    train_data = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+               "snapshot_freq": 5, "output_model": str(out)},
+              train_data, num_boost_round=10, verbose_eval=False)
+    snap5 = tmp_path / "model.txt.snapshot_iter_5"
+    snap10 = tmp_path / "model.txt.snapshot_iter_10"
+    assert snap5.exists() and snap10.exists()
+    bst5 = lgb.Booster(model_file=str(snap5))
+    assert bst5.num_trees() == 5
