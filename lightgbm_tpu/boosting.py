@@ -105,8 +105,7 @@ class GBDT:
             lambda_l2=cfg.lambda_l2,
             min_gain_to_split=cfg.min_gain_to_split,
             max_bin=train.max_num_bin(),
-            hist_method=("pallas" if cfg.use_pallas and _on_tpu() else "auto"),
-            rows_per_chunk=cfg.rows_per_chunk or 16384,
+            hist_method=("pallas" if cfg.use_pallas and _on_tpu() else "einsum"),
             has_categorical=bool(np.asarray(fm["is_categorical"]).any()),
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_group=cfg.max_cat_group,

@@ -219,8 +219,7 @@ class Config:
     gpu_device_id: int = -1
     gpu_use_dp: bool = False
     hist_dtype: str = "float32"    # accumulator dtype for histograms
-    use_pallas: bool = False       # pallas kernel on TPU; XLA fallback otherwise
-    rows_per_chunk: int = 0        # 0 = auto
+    use_pallas: bool = True        # Pallas hist kernel on TPU; einsum otherwise
 
     # file-task fields (CLI)
     data: str = ""

@@ -3,18 +3,27 @@
 TPU-native re-design of ``SerialTreeLearner::Train``
 (``src/treelearner/serial_tree_learner.cpp:152-205``):
 
-* the reference's ``DataPartition`` index reordering becomes a static-shape
-  ``row_leaf`` assignment vector (no compaction, no dynamic shapes);
-* per-split histogram work is one masked sweep that produces BOTH children
-  of the split in a single pass (see ``ops.histogram``), replacing the
-  smaller-child + parent-subtraction trick;
+* the reference's ``DataPartition`` index reordering is kept as-is on device:
+  a position array ``order`` groups rows contiguously by leaf
+  (``data_partition.hpp:94-146``), updated per split by a cumsum-rank
+  scatter (stable partition, all O(N) streaming ops);
+* per split only the **smaller child** is histogrammed — its rows are
+  gathered through ``order`` into a power-of-two padded buffer chosen by
+  ``lax.switch`` (static shapes, ~log2(N) compiled buckets) and reduced by
+  a one-hot MXU matmul (Pallas kernel on TPU); the larger child is obtained
+  by parent − smaller subtraction exactly like the reference
+  (``serial_tree_learner.cpp:482-488``).  Per-leaf parent histograms live in
+  an HBM pool ``hist_store [L, F, B, 3]`` — the reference's HistogramPool
+  (``feature_histogram.hpp:429-597``) without the LRU, since HBM fits all
+  leaves;
 * the split loop is a ``lax.while_loop`` with all per-leaf state in fixed
-  ``[num_leaves]`` arrays, so one compilation serves every tree;
+  ``[num_leaves]`` arrays, so one compilation serves every tree and there
+  are no host round-trips inside a tree;
 * distribution hooks in via a strategy object (``SerialStrategy`` here,
-  parallel variants in ``parallel.learner``) whose ``hist``/``find`` methods
-  insert XLA collectives — the data-parallel learner's ReduceScatter
-  (``data_parallel_tree_learner.cpp:148-163``) collapses to a ``psum``/
-  ``psum_scatter`` inside ``hist``.
+  parallel variants in ``parallel.learner``) whose ``reduce_hist``/``find``
+  methods insert XLA collectives — the data-parallel learner's ReduceScatter
+  (``data_parallel_tree_learner.cpp:148-163``) collapses to a ``psum`` of
+  the smaller-child histogram.
 
 Output is a struct-of-arrays tree (same SoA layout as the reference ``Tree``,
 ``include/LightGBM/tree.h:20-370``) plus the final row→leaf map used for the
@@ -28,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .ops.histogram import child_histograms
+from .ops.histogram import subset_histogram
 from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
                         best_split, leaf_output)
 
@@ -43,8 +52,8 @@ class GrowerConfig(NamedTuple):
     lambda_l2: float = 0.0
     min_gain_to_split: float = 0.0
     max_bin: int = 256               # B: histogram width (max over features)
-    hist_method: str = "auto"        # onehot | segsum | pallas | auto
-    rows_per_chunk: int = 16384
+    hist_method: str = "auto"        # pallas | einsum | auto
+    bucket_min_log2: int = 10        # smallest pow2 gather-buffer bucket
     has_categorical: bool = False    # static: enables the categorical path
     max_cat_threshold: int = 256
     max_cat_group: int = 64
@@ -89,7 +98,12 @@ class FeatureMeta(NamedTuple):
 
 class _LoopState(NamedTuple):
     step: jnp.ndarray
-    row_leaf: jnp.ndarray
+    row_leaf: jnp.ndarray        # [N] i32: leaf id per row
+    pos: jnp.ndarray             # [N] i32: position of each row in `order`
+    order: jnp.ndarray           # [N + maxbuf] i32: row ids grouped by leaf
+    leaf_start: jnp.ndarray      # [L] i32: first position of each leaf
+    leaf_cnt: jnp.ndarray        # [L] i32: local row count of each leaf
+    hist_store: jnp.ndarray      # [L, F, B, 3]: per-leaf histograms
     splits: SplitResult          # per-leaf SoA, each field [L]
     tree: TreeArrays
 
@@ -97,17 +111,20 @@ class _LoopState(NamedTuple):
 class SerialStrategy:
     """Single-device learner (SerialTreeLearner analogue).
 
-    A strategy supplies three traced hooks to the grower; the parallel tree
-    learners of the reference (data / feature / voting,
-    ``src/treelearner/*parallel*``) are alternative strategies in
+    A strategy supplies the traced hooks that differ between the reference's
+    tree learners (serial / data / feature / voting,
+    ``src/treelearner/*tree_learner.cpp``); parallel variants live in
     ``lightgbm_tpu.parallel.learner``:
 
-    * ``setup(bins, meta, feat_valid) -> ctx``  — per-shard views
-    * ``hist(ctx, bins, seg, gw, hw, cw) -> [2, F', B, 3]`` — child
-      histograms, reduced across the mesh as the strategy requires
-    * ``find(ctx, hist_child, pg, ph, pc) -> SplitResult`` — globally agreed
-      best split (feature indices in the full/global numbering)
-    * ``reduce_scalar(x)`` — global sums of row statistics
+    * ``setup(bins, meta, feat_valid) -> ctx`` — per-shard feature views;
+    * ``hist_bins(ctx, bins) -> [N, F_hist]`` — the matrix to histogram
+      (feature-parallel shards slice their own columns);
+    * ``reduce_hist(hist) -> hist`` — cross-shard reduction of a freshly
+      measured histogram (data-parallel: ``psum``; voting: identity, its
+      reduction happens selectively inside ``find``);
+    * ``find(ctx, hist, pg, ph, pc) -> SplitResult`` — globally agreed best
+      split (feature indices in the full/global numbering);
+    * ``reduce_scalar(x)`` — global sums of row statistics.
     """
 
     def __init__(self, cfg: "GrowerConfig"):
@@ -116,14 +133,15 @@ class SerialStrategy:
     def setup(self, bins, meta: FeatureMeta, feat_valid):
         return (meta, feat_valid)
 
-    def hist(self, ctx, bins, seg, gw, hw, cw):
-        return child_histograms(bins, seg, gw, hw, cw, self.cfg.max_bin,
-                                method=self.cfg.hist_method,
-                                rows_per_chunk=self.cfg.rows_per_chunk)
+    def hist_bins(self, ctx, bins):
+        return bins
 
-    def find(self, ctx, hist_child, pg, ph, pc):
+    def reduce_hist(self, hist):
+        return hist
+
+    def find(self, ctx, hist, pg, ph, pc):
         meta, feat_valid = ctx
-        return best_split(hist_child, pg, ph, pc, meta.num_bin,
+        return best_split(hist, pg, ph, pc, meta.num_bin,
                           meta.missing_type, meta.default_bin, feat_valid,
                           self.cfg.split_config(), is_cat=meta.is_categorical)
 
@@ -149,6 +167,14 @@ def _depth_gate(res: SplitResult, leaf_depth, max_depth) -> SplitResult:
                         gain=jnp.where(ok, res.gain, -jnp.inf))
 
 
+def _bucket_index(scnt, kmin: int, kmax: int):
+    """Index of the smallest pow2 bucket holding ``scnt`` rows: exact
+    integer comparisons against a static power table (a float log2 would
+    mis-round near large powers of two and silently drop rows)."""
+    table = jnp.asarray([1 << j for j in range(kmin, kmax)], jnp.int32)
+    return jnp.sum((scnt > table).astype(jnp.int32))
+
+
 def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
     """Build the jittable ``grow_tree`` function.
 
@@ -171,19 +197,61 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
         n, f = bins.shape
         dtype = gw.dtype
         ctx = strategy.setup(bins, meta, feat_valid)
+        hbins = strategy.hist_bins(ctx, bins)        # [N, F_hist]
+        fh = hbins.shape[1]
 
-        def find(hist_child, pg, ph, pc):
-            return strategy.find(ctx, hist_child, pg, ph, pc)
+        # pow2 gather buckets for the smaller child (static branch sizes)
+        kmin = cfg.bucket_min_log2
+        kmax = max(int(n - 1).bit_length(), kmin)
+        maxbuf = 1 << kmax
 
+        # sentinel row n: weight 0, bin 0 — receives all buffer padding
+        hbins_pad = jnp.concatenate(
+            [hbins, jnp.zeros((1, fh), hbins.dtype)], axis=0)
+        gw_pad = jnp.concatenate([gw, jnp.zeros((1,), dtype)])
+        hw_pad = jnp.concatenate([hw, jnp.zeros((1,), dtype)])
+        cw_pad = jnp.concatenate([cw, jnp.zeros((1,), dtype)])
+
+        def find(hist, pg, ph, pc):
+            return strategy.find(ctx, hist, pg, ph, pc)
+
+        def measure(idx):
+            """Histogram of rows ``idx`` (sentinel-padded) -> [F_hist, B, 3]."""
+            rows = jnp.take(hbins_pad, idx, axis=0)
+            return subset_histogram(rows, gw_pad[idx], hw_pad[idx],
+                                    cw_pad[idx], cfg.max_bin,
+                                    method=cfg.hist_method)
+
+        def bucket_branch(k):
+            def branch(args):
+                order, sstart, scnt = args
+                idx = lax.dynamic_slice(order, (sstart,), (1 << k,))
+                valid = jnp.arange(1 << k, dtype=jnp.int32) < scnt
+                return measure(jnp.where(valid, idx, n))
+            return branch
+
+        branches = [bucket_branch(k) for k in range(kmin, kmax + 1)]
+
+        # ---- root ----------------------------------------------------------
         root_g = strategy.reduce_scalar(jnp.sum(gw))
         root_h = strategy.reduce_scalar(jnp.sum(hw))
         root_c = strategy.reduce_scalar(jnp.sum(cw))
 
         row_leaf = jnp.zeros((n,), jnp.int32)
-        seg0 = jnp.zeros((n,), jnp.int32)   # all rows in "left" slot -> root hist
-        hist_root = strategy.hist(ctx, bins, seg0, gw, hw, cw)[0]
+        pos0 = jnp.arange(n, dtype=jnp.int32)
+        order0 = jnp.concatenate(
+            [pos0, jnp.full((maxbuf,), n, jnp.int32)])
+        leaf_start0 = jnp.zeros((L,), jnp.int32)
+        leaf_cnt0 = _set(jnp.zeros((L,), jnp.int32), 0, n)
+
+        hist_root = strategy.reduce_hist(
+            subset_histogram(hbins, gw, hw, cw, cfg.max_bin,
+                             method=cfg.hist_method))
         res_root = find(hist_root, root_g, root_h, root_c)
         res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
+
+        hist_store0 = jnp.zeros((L, fh, cfg.max_bin, 3), dtype)
+        hist_store0 = hist_store0.at[0].set(hist_root)
 
         def blank_res(x):
             return jnp.zeros((L,) + x.shape, x.dtype)
@@ -226,7 +294,7 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             thr = splits.threshold[l]
             dleft = splits.default_left[l]
 
-            # --- partition rows of leaf l (DataPartition::Split analogue) ----
+            # --- decide row routing for leaf l (tree.h:257-313 semantics) ----
             binf = lax.dynamic_index_in_dim(bins, feat, axis=1,
                                             keepdims=False).astype(jnp.int32)
             mt_f = meta.missing_type[feat]
@@ -242,6 +310,25 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             goes_left = jnp.where(splits.is_cat[l], cat_go_left, goes_left)
             in_leaf = state.row_leaf == l
             row_leaf = jnp.where(in_leaf & ~goes_left, new_leaf, state.row_leaf)
+
+            # --- stable partition of the leaf's positions (DataPartition::
+            #     Split, data_partition.hpp:94-146): cumsum ranks + scatter ---
+            start = state.leaf_start[l]
+            cnt = state.leaf_cnt[l]
+            m_left = in_leaf & goes_left
+            m_right = in_leaf & ~goes_left
+            c1 = jnp.cumsum(m_left.astype(jnp.int32))
+            c0 = jnp.cumsum(m_right.astype(jnp.int32))
+            nl = c1[-1]                       # local left count
+            nr = cnt - nl
+            pos = jnp.where(
+                in_leaf,
+                start + jnp.where(m_left, c1 - 1, nl + c0 - 1),
+                state.pos)
+            order = jnp.full((n + maxbuf,), n, jnp.int32).at[pos].set(
+                jnp.arange(n, dtype=jnp.int32))
+            leaf_start = _set(state.leaf_start, new_leaf, start + nl)
+            leaf_cnt = _set(_set(state.leaf_cnt, l, nl), new_leaf, nr)
 
             # --- record the node (Tree::Split, tree.h:319-345) ---------------
             parent_node = tree.leaf_parent[l]
@@ -282,22 +369,39 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
                 cat_bins=tree.cat_bins.at[node].set(splits.cat_bins[l]),
             )
 
-            # --- histograms + best splits for both children in one sweep -----
-            seg = jnp.where(row_leaf == l, 0,
-                            jnp.where(row_leaf == new_leaf, 1, 2))
-            hist2 = strategy.hist(ctx, bins, seg, gw, hw, cw)
-            res_l = find(hist2[0], splits.left_sum_g[l], splits.left_sum_h[l],
+            # --- smaller-child histogram + parent subtraction ----------------
+            # (the reference's smaller/larger trick,
+            #  serial_tree_learner.cpp:326-404,482-488)
+            small_left = splits.left_count[l] <= splits.right_count[l]
+            sstart = jnp.where(small_left, start, start + nl)
+            scnt = jnp.where(small_left, nl, nr)   # LOCAL count of that child
+            ki = _bucket_index(scnt, kmin, kmax)
+            hist_small = lax.switch(ki, branches, (order, sstart, scnt))
+            hist_small = strategy.reduce_hist(hist_small)
+            hist_parent = lax.dynamic_index_in_dim(state.hist_store, l, axis=0,
+                                                   keepdims=False)
+            hist_large = hist_parent - hist_small
+            hist_l = jnp.where(small_left, hist_small, hist_large)
+            hist_r = jnp.where(small_left, hist_large, hist_small)
+            hist_store = lax.dynamic_update_index_in_dim(
+                state.hist_store, hist_l, l, axis=0)
+            hist_store = lax.dynamic_update_index_in_dim(
+                hist_store, hist_r, new_leaf, axis=0)
+
+            res_l = find(hist_l, splits.left_sum_g[l], splits.left_sum_h[l],
                          splits.left_count[l])
-            res_r = find(hist2[1], splits.right_sum_g[l], splits.right_sum_h[l],
+            res_r = find(hist_r, splits.right_sum_g[l], splits.right_sum_h[l],
                          splits.right_count[l])
             res_l = _depth_gate(res_l, child_depth, cfg.max_depth)
             res_r = _depth_gate(res_r, child_depth, cfg.max_depth)
 
             splits = _update_splits(splits, l, res_l)
             splits = _update_splits(splits, new_leaf, res_r)
-            return _LoopState(i + 1, row_leaf, splits, tree)
+            return _LoopState(i + 1, row_leaf, pos, order, leaf_start,
+                              leaf_cnt, hist_store, splits, tree)
 
-        state = _LoopState(jnp.asarray(0, jnp.int32), row_leaf, splits, tree)
+        state = _LoopState(jnp.asarray(0, jnp.int32), row_leaf, pos0, order0,
+                           leaf_start0, leaf_cnt0, hist_store0, splits, tree)
         state = lax.while_loop(cond, body, state)
         return state.tree, state.row_leaf
 

@@ -3,121 +3,80 @@
 The reference builds per-leaf feature histograms with cache-tuned scatter-adds
 (``src/io/dense_bin.hpp:66-132``) or an OpenCL local-memory atomic kernel
 (``src/treelearner/ocl/histogram256.cl``).  TPUs have no fast random scatter,
-so the native formulations here are:
+so the native formulation is a one-hot × weights contraction on the MXU over a
+*gathered row subset* — the grower gathers only the smaller child of each
+split through its leaf-contiguous ``order`` array (the reference's
+smaller-child trick, ``serial_tree_learner.cpp:326-404``), so the work per
+split is proportional to the smaller child, not to the dataset:
 
-* ``child_histograms_onehot`` — one-hot × weights matmul on the MXU,
-  row-chunked so the one-hot tensor never materialises in HBM.  This is the
-  default TPU path (and the shape the Pallas kernel mirrors).
-* ``child_histograms_segsum`` — ``jax.ops.segment_sum`` per feature.  Scatter
-  based; used as the debugging / parity oracle (the reference's
-  GPU_DEBUG_COMPARE discipline, ``gpu_tree_learner.cpp:1018-1043``).
-
-Both compute histograms for the *two children of a split in one pass*: rows
-carry a segment id (0 = left child, 1 = right child, >=2 = other leaves), so a
-single sweep yields both children — which replaces the reference's
-"smaller-child + parent-subtraction" trick without giving up any work: a
-masked TPU sweep touches every row regardless of how many segments it bins.
+* ``subset_histogram_einsum`` — chunked f32 one-hot einsum; CPU / parity path.
+* ``pallas_hist.subset_histogram_pallas`` — bf16 MXU Pallas kernel whose
+  one-hot tile never leaves VMEM; hi/lo-split weights keep ~f32 accuracy.
 
 Each histogram entry is ``(sum_gradients, sum_hessians, count)`` exactly like
 the reference ``HistogramBinEntry`` (``include/LightGBM/bin.h:27-56``).
 """
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-NUM_CHILDREN = 2  # left/right of the split being evaluated
 NUM_STATS = 3     # (sum_grad, sum_hess, count)
 
 
-def child_histograms_segsum(bins: jnp.ndarray, seg: jnp.ndarray,
-                            grad: jnp.ndarray, hess: jnp.ndarray,
-                            cnt: jnp.ndarray, num_bins: int) -> jnp.ndarray:
-    """Scatter-add path. bins: [N, F] int; seg: [N] int in {0,1,2}.
+def _split_hi_lo(x: jnp.ndarray):
+    """Split f32 into a (bf16 hi, bf16 lo) pair so a single-pass bf16 MXU
+    matmul accumulates with ~f32 accuracy (hi + lo recombined after the dot).
+    The one-hot operand is exact in bf16, so only the weights need splitting."""
+    hi = x.astype(jnp.bfloat16)
+    lo = (x - hi.astype(x.dtype)).astype(jnp.bfloat16)
+    return hi, lo
 
-    Returns [2, F, B, 3] with B = ``num_bins``.
-    """
-    bins = bins.astype(jnp.int32)
-    n, f = bins.shape
+
+def subset_histogram_einsum(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
+                            c: jnp.ndarray, num_bins: int,
+                            rows_per_chunk: int = 8192) -> jnp.ndarray:
+    """Histogram of a gathered row subset: rows [M, F] int, g/h/c [M] f32
+    (weights must be 0 for padding rows) -> [F, B, 3].
+
+    f32 one-hot x weights einsum, chunked over rows so the one-hot tensor
+    stays small.  This is the CPU / debugging path; the TPU path is the
+    Pallas kernel (``pallas_hist.subset_histogram_pallas``)."""
+    rows = rows.astype(jnp.int32)
+    m, f = rows.shape
     b = num_bins
-    # combined id per (row, feature): seg * B + bin ; segment 2 is a trash slot
-    ids = seg[:, None] * b + bins                      # [N, F]
-    data = jnp.stack([grad, hess, cnt], axis=-1)       # [N, 3]
-
-    def per_feature(ids_f):
-        return jax.ops.segment_sum(data, ids_f, num_segments=3 * b)  # [3B, 3]
-
-    hist = jax.vmap(per_feature, in_axes=1)(ids)       # [F, 3B, 3]
-    hist = hist.reshape(f, 3, b, NUM_STATS)
-    return jnp.moveaxis(hist, 1, 0)[:NUM_CHILDREN]     # [2, F, B, 3]
-
-
-def child_histograms_onehot(bins: jnp.ndarray, seg: jnp.ndarray,
-                            grad: jnp.ndarray, hess: jnp.ndarray,
-                            cnt: jnp.ndarray, num_bins: int,
-                            rows_per_chunk: int = 16384) -> jnp.ndarray:
-    """MXU path: per row-chunk, build a one-hot of the bin index in registers/
-    VMEM and contract it against the 6 per-row weight channels
-    (g,h,c for each child).  [N, F] x chunking keeps peak memory at
-    ``chunk * F * B`` for the fused one-hot, which XLA materialises only
-    tile-by-tile inside the fused matmul loop.
-    """
-    bins = bins.astype(jnp.int32)
-    n, f = bins.shape
-    b = num_bins
-    left = (seg == 0)
-    right = (seg == 1)
-    w = jnp.stack([
-        jnp.where(left, grad, 0.0), jnp.where(left, hess, 0.0),
-        jnp.where(left, cnt, 0.0),
-        jnp.where(right, grad, 0.0), jnp.where(right, hess, 0.0),
-        jnp.where(right, cnt, 0.0),
-    ], axis=-1)                                        # [N, 6]
-
-    chunk = min(rows_per_chunk, n)
-    pad = (-n) % chunk
+    w = jnp.stack([g, h, c], axis=-1)                   # [M, 3]
+    chunk = min(rows_per_chunk, m)
+    pad = (-m) % chunk
     if pad:
-        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        rows = jnp.pad(rows, ((0, pad), (0, 0)))
         w = jnp.pad(w, ((0, pad), (0, 0)))
-    n_chunks = (n + pad) // chunk
-    bins_c = bins.reshape(n_chunks, chunk, f)
-    w_c = w.reshape(n_chunks, chunk, 2 * NUM_STATS)
+    n_chunks = (m + pad) // chunk
+    rows_c = rows.reshape(n_chunks, chunk, f)
+    w_c = w.reshape(n_chunks, chunk, NUM_STATS)
 
     def body(acc, args):
-        bc, wc = args                                   # [chunk, F], [chunk, 6]
-        onehot = (bc[:, :, None] == lax.broadcasted_iota(jnp.int32, (1, 1, b), 2))
-        onehot = onehot.astype(wc.dtype)                # [chunk, F, B]
-        part = jnp.einsum("cfb,ck->fbk", onehot, wc,
-                          precision=lax.Precision.HIGHEST)  # [F, B, 6]
+        rc, wc = args
+        onehot = (rc[:, :, None] == lax.broadcasted_iota(jnp.int32, (1, 1, b), 2))
+        part = jnp.einsum("mfb,mk->fbk", onehot.astype(wc.dtype), wc,
+                          precision=lax.Precision.HIGHEST)
         return acc + part, None
 
-    acc0 = jnp.zeros((f, b, 2 * NUM_STATS), dtype=w.dtype)
-    acc, _ = lax.scan(body, acc0, (bins_c, w_c))
-    return jnp.moveaxis(acc.reshape(f, b, NUM_CHILDREN, NUM_STATS), 2, 0)
+    acc0 = jnp.zeros((f, b, NUM_STATS), dtype=w.dtype)
+    acc, _ = lax.scan(body, acc0, (rows_c, w_c))
+    return acc
 
 
-def child_histograms(bins: jnp.ndarray, seg: jnp.ndarray,
-                     grad: jnp.ndarray, hess: jnp.ndarray,
-                     cnt: jnp.ndarray, num_bins: int,
-                     method: str = "auto",
-                     rows_per_chunk: int = 16384) -> jnp.ndarray:
-    """Dispatch histogram construction by method: auto|onehot|segsum|pallas."""
+def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
+                     c: jnp.ndarray, num_bins: int,
+                     method: str = "auto") -> jnp.ndarray:
+    """Dispatch subset histogram: rows [M, F] int, g/h/c [M] -> [F, B, 3]."""
     if method == "auto":
-        method = "onehot" if any(d.platform == "tpu" for d in jax.devices()) else "segsum"
-    if method == "segsum":
-        return child_histograms_segsum(bins, seg, grad, hess, cnt, num_bins)
-    if method == "onehot":
-        return child_histograms_onehot(bins, seg, grad, hess, cnt, num_bins,
-                                       rows_per_chunk)
+        method = ("pallas"
+                  if any(d.platform == "tpu" for d in jax.devices())
+                  else "einsum")
     if method == "pallas":
-        try:
-            from .pallas_hist import child_histograms_pallas
-        except ImportError:
-            return child_histograms_onehot(bins, seg, grad, hess, cnt, num_bins,
-                                           rows_per_chunk)
-        return child_histograms_pallas(bins, seg, grad, hess, cnt, num_bins)
-    raise ValueError(f"unknown histogram method {method}")
+        from .pallas_hist import subset_histogram_pallas
+        return subset_histogram_pallas(rows, g, h, c, num_bins)
+    return subset_histogram_einsum(rows, g, h, c, num_bins)

@@ -73,33 +73,33 @@ def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
     )(bins_t, w_t)
 
 
-def child_histograms_pallas(bins: jnp.ndarray, seg: jnp.ndarray,
-                            grad: jnp.ndarray, hess: jnp.ndarray,
-                            cnt: jnp.ndarray, num_bins: int,
-                            feat_tile: int = 8,
-                            row_tile: int = 1024,
+def subset_histogram_pallas(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
+                            c: jnp.ndarray, num_bins: int,
+                            feat_tile: int = 8, row_tile: int = 512,
                             interpret: bool = False) -> jnp.ndarray:
-    """Drop-in for ops.histogram.child_histograms: [2, F, B, 3]."""
-    n, f = bins.shape
-    left = (seg == 0)
-    right = (seg == 1)
-    w_t = jnp.stack([
-        jnp.where(left, grad, 0.0), jnp.where(left, hess, 0.0),
-        jnp.where(left, cnt, 0.0),
-        jnp.where(right, grad, 0.0), jnp.where(right, hess, 0.0),
-        jnp.where(right, cnt, 0.0),
-    ], axis=0).astype(jnp.float32)                  # [6, N]
+    """Histogram of a gathered row subset: rows [M, F] int, g/h/c [M] f32
+    (0 for padding rows) -> [F, B, 3].
 
-    pad_n = (-n) % row_tile
+    Single-pass bf16 MXU matmul with hi/lo-split weights for ~f32 accuracy:
+    channels are (g_hi, g_lo, h_hi, h_lo, c, 0); the f32 histogram is
+    recombined as hi + lo after the f32-accumulated dot."""
+    from .histogram import _split_hi_lo
+    m, f = rows.shape
+    g_hi, g_lo = _split_hi_lo(g.astype(jnp.float32))
+    h_hi, h_lo = _split_hi_lo(h.astype(jnp.float32))
+    w_t = jnp.stack([g_hi, g_lo, h_hi, h_lo,
+                     c.astype(jnp.bfloat16),
+                     jnp.zeros_like(c, jnp.bfloat16)], axis=0)   # [6, M] bf16
+    bins_t = rows.astype(jnp.int32).T                            # [F, M]
     pad_f = (-f) % feat_tile
-    bins_t = bins.astype(jnp.int32).T               # [F, N]
+    pad_m = (-m) % row_tile
     if pad_f:
         bins_t = jnp.pad(bins_t, ((0, pad_f), (0, 0)))
-    if pad_n:
-        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad_n)))
-        w_t = jnp.pad(w_t, ((0, 0), (0, pad_n)))
-
+    if pad_m:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad_m)))
+        w_t = jnp.pad(w_t, ((0, 0), (0, pad_m)))
     hist6 = hist6_pallas(bins_t, w_t, num_bins, feat_tile, row_tile,
-                         interpret=interpret)[:, :f]      # [6, F, B]
-    # [6, F, B] -> [2, F, B, 3]
-    return jnp.moveaxis(hist6.reshape(2, 3, f, num_bins), 1, 3)
+                         interpret=interpret)[:, :f]             # [6, F, B]
+    hist_g = hist6[0] + hist6[1]
+    hist_h = hist6[2] + hist6[3]
+    return jnp.stack([hist_g, hist_h, hist6[4]], axis=-1)        # [F, B, 3]

@@ -35,7 +35,6 @@ except ImportError:  # pragma: no cover
 
 from ..grower import (FeatureMeta, GrowerConfig, SerialStrategy, TreeArrays,
                       make_grower)
-from ..ops.histogram import child_histograms
 from ..ops.split import SplitResult, best_split, per_feature_best_gain
 
 
@@ -67,17 +66,21 @@ def _broadcast_from_winner(res: SplitResult, axis_name: str) -> SplitResult:
 
 
 class DataParallelStrategy(SerialStrategy):
-    """Rows sharded over ``axis_name``; histograms psum-reduced."""
+    """Rows sharded over ``axis_name``; histograms psum-reduced.
+
+    The smaller-child histogram measured by each shard over its local rows is
+    psum-reduced (the ReduceScatter + ownership plan of
+    ``data_parallel_tree_learner.cpp:148-163`` collapsed to one collective);
+    the parent subtraction then happens on the already-global histograms, so
+    the larger child is never communicated — exactly the reference's
+    guarantee (``:246-252``)."""
 
     def __init__(self, cfg: GrowerConfig, axis_name: str = "data"):
         super().__init__(cfg)
         self.axis = axis_name
 
-    def hist(self, ctx, bins, seg, gw, hw, cw):
-        local = child_histograms(bins, seg, gw, hw, cw, self.cfg.max_bin,
-                                 method=self.cfg.hist_method,
-                                 rows_per_chunk=self.cfg.rows_per_chunk)
-        return lax.psum(local, self.axis)
+    def reduce_hist(self, hist):
+        return lax.psum(hist, self.axis)
 
     def reduce_scalar(self, x):
         return lax.psum(x, self.axis)
@@ -107,11 +110,8 @@ class FeatureParallelStrategy(SerialStrategy):
         fv_local = lax.dynamic_slice(feat_valid, (start,), (fl,))
         return (meta, feat_valid, bins_local, meta_local, fv_local, start)
 
-    def hist(self, ctx, bins, seg, gw, hw, cw):
-        bins_local = ctx[2]
-        return child_histograms(bins_local, seg, gw, hw, cw, self.cfg.max_bin,
-                                method=self.cfg.hist_method,
-                                rows_per_chunk=self.cfg.rows_per_chunk)
+    def hist_bins(self, ctx, bins):
+        return ctx[2]
 
     def find(self, ctx, hist_child, pg, ph, pc):
         _, _, _, meta_local, fv_local, start = ctx
@@ -141,10 +141,10 @@ class VotingStrategy(SerialStrategy):
     def reduce_scalar(self, x):
         return lax.psum(x, self.axis)
 
-    def hist(self, ctx, bins, seg, gw, hw, cw):
-        return child_histograms(bins, seg, gw, hw, cw, self.cfg.max_bin,
-                                method=self.cfg.hist_method,
-                                rows_per_chunk=self.cfg.rows_per_chunk)
+    # reduce_hist stays identity: histograms remain LOCAL and only the
+    # voted feature slices are psum-reduced inside ``find`` (PV-tree's
+    # communication compression); the parent-minus-smaller subtraction in
+    # the grower is therefore performed in each shard's local space.
 
     def find(self, ctx, hist_child, pg, ph, pc):
         meta, feat_valid = ctx

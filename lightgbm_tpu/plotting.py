@@ -199,13 +199,18 @@ def create_tree_digraph(booster, tree_index: int = 0,
             if feature_names is not None and 0 <= feat < len(feature_names):
                 feat = feature_names[feat]
             label = f"split_feature_name: {feat}"
-            label += f"\\nthreshold: {_float2str(node['threshold'], precision)}"
+            is_cat = node.get("decision_type") == "categorical"
+            if is_cat:
+                left_edge, right_edge = "in set", "not in set"
+            else:
+                left_edge, right_edge = node.get("decision_type", "<="), ">"
+                label += f"\\nthreshold: {_float2str(node['threshold'], precision)}"
             for info in ("split_gain", "internal_value", "internal_count"):
                 if info in show_info and info in node:
                     label += f"\\n{info}: {_float2str(node[info], precision)}"
             graph.node(nid, label=label)
-            add(node["left_child"], nid, node.get("decision_type", "<=") + "")
-            add(node["right_child"], nid, ">")
+            add(node["left_child"], nid, left_edge)
+            add(node["right_child"], nid, right_edge)
         else:
             nid = f"leaf{node['leaf_index']}"
             label = f"leaf_index: {node['leaf_index']}"

@@ -1,67 +1,84 @@
 """Histogram kernel parity — the reference's GPU_DEBUG_COMPARE discipline
 (gpu_tree_learner.cpp:1018-1043) as a real test: every backend path must
-produce identical histograms."""
+produce identical histograms, including sentinel-padded gather rows."""
 import numpy as np
 import pytest
 
 import jax.numpy as jnp
 
-from lightgbm_tpu.ops.histogram import (child_histograms_onehot,
-                                        child_histograms_segsum)
-from lightgbm_tpu.ops.pallas_hist import child_histograms_pallas
+from lightgbm_tpu.ops.histogram import (_split_hi_lo, subset_histogram_einsum)
+from lightgbm_tpu.ops.pallas_hist import subset_histogram_pallas
 
 
 @pytest.fixture(scope="module")
 def problem():
+    """A gathered smaller-child buffer: real rows then sentinel padding
+    (the grower pads pow2 buckets with a zero-weight sentinel row)."""
     rng = np.random.RandomState(0)
-    n, f, b = 4096, 12, 64
-    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
-    seg = rng.randint(0, 3, size=n).astype(np.int32)
-    g = rng.randn(n).astype(np.float32)
-    h = np.abs(rng.randn(n)).astype(np.float32)
-    c = (rng.rand(n) > 0.2).astype(np.float32)
-    return bins, seg, g, h, c, b
+    m, f, b = 4096, 12, 64
+    real = 3000
+    rows = rng.randint(0, b, size=(m, f)).astype(np.uint8)
+    g = rng.randn(m).astype(np.float32)
+    h = np.abs(rng.randn(m)).astype(np.float32)
+    c = (rng.rand(m) > 0.2).astype(np.float32)
+    # padding rows: weight 0 (must not contribute)
+    g[real:] = 0.0
+    h[real:] = 0.0
+    c[real:] = 0.0
+    return rows, g, h, c, b, real
 
 
-def _numpy_reference(bins, seg, g, h, c, b):
-    n, f = bins.shape
-    out = np.zeros((2, f, b, 3), dtype=np.float64)
-    for child in (0, 1):
-        mask = seg == child
-        for j in range(f):
-            for arr, k in ((g, 0), (h, 1), (c, 2)):
-                np.add.at(out[child, j, :, k], bins[mask, j],
-                          arr[mask].astype(np.float64))
+def _numpy_reference(rows, g, h, c, b):
+    m, f = rows.shape
+    out = np.zeros((f, b, 3), dtype=np.float64)
+    for j in range(f):
+        for arr, k in ((g, 0), (h, 1), (c, 2)):
+            np.add.at(out[j, :, k], rows[:, j], arr.astype(np.float64))
     return out
 
 
-def test_segsum_matches_numpy(problem):
-    bins, seg, g, h, c, b = problem
-    ref = _numpy_reference(bins, seg, g, h, c, b)
-    out = np.asarray(child_histograms_segsum(
-        jnp.asarray(bins), jnp.asarray(seg), jnp.asarray(g),
-        jnp.asarray(h), jnp.asarray(c), b))
+def test_einsum_matches_numpy(problem):
+    rows, g, h, c, b, real = problem
+    ref = _numpy_reference(rows, g, h, c, b)
+    out = np.asarray(subset_histogram_einsum(
+        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+        b, rows_per_chunk=1024))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+    # padding rows carried zero weight: count equals real active rows
+    assert out[:, :, 2].sum(axis=1) == pytest.approx(c.sum())
 
 
-def test_onehot_matches_segsum(problem):
-    bins, seg, g, h, c, b = problem
-    a = np.asarray(child_histograms_segsum(
-        jnp.asarray(bins), jnp.asarray(seg), jnp.asarray(g),
-        jnp.asarray(h), jnp.asarray(c), b))
-    o = np.asarray(child_histograms_onehot(
-        jnp.asarray(bins), jnp.asarray(seg), jnp.asarray(g),
-        jnp.asarray(h), jnp.asarray(c), b, rows_per_chunk=1024))
-    np.testing.assert_allclose(o, a, rtol=1e-5, atol=1e-4)
+def test_pallas_matches_einsum_interpret(problem):
+    rows, g, h, c, b, real = problem
+    a = np.asarray(subset_histogram_einsum(
+        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c), b))
+    p = np.asarray(subset_histogram_pallas(
+        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+        b, feat_tile=4, row_tile=512, interpret=True))
+    # bf16 hi/lo split: ~2^-17 relative error on the g/h sums, counts exact
+    np.testing.assert_allclose(p, a, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(p[:, :, 2], a[:, :, 2])
 
 
-def test_pallas_matches_segsum_interpret(problem):
-    bins, seg, g, h, c, b = problem
-    a = np.asarray(child_histograms_segsum(
-        jnp.asarray(bins), jnp.asarray(seg), jnp.asarray(g),
-        jnp.asarray(h), jnp.asarray(c), b))
-    p = np.asarray(child_histograms_pallas(
-        jnp.asarray(bins), jnp.asarray(seg), jnp.asarray(g),
-        jnp.asarray(h), jnp.asarray(c), b, feat_tile=4, row_tile=512,
-        interpret=True))
-    np.testing.assert_allclose(p, a, rtol=1e-5, atol=1e-4)
+def test_hi_lo_split_accuracy():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(10000).astype(np.float32) * 100)
+    hi, lo = _split_hi_lo(x)
+    rec = hi.astype(jnp.float32) + lo.astype(jnp.float32)
+    # two-level bf16 split: relative error bounded by ~2^-17
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(x), rtol=1e-5)
+
+
+def test_pallas_odd_sizes_interpret():
+    """F and M not multiples of the tile sizes exercise the padding path."""
+    rng = np.random.RandomState(2)
+    m, f, b = 700, 5, 16
+    rows = rng.randint(0, b, size=(m, f)).astype(np.uint8)
+    g = rng.randn(m).astype(np.float32)
+    h = np.ones(m, np.float32)
+    c = np.ones(m, np.float32)
+    ref = _numpy_reference(rows, g, h, c, b)
+    p = np.asarray(subset_histogram_pallas(
+        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+        b, feat_tile=4, row_tile=512, interpret=True))
+    np.testing.assert_allclose(p, ref, rtol=3e-4, atol=3e-4)
