@@ -60,6 +60,24 @@ def run_predict(cfg: Config, params: Dict[str, str]) -> None:
         log.fatal("No prediction data specified (data=...)")
     if not cfg.input_model:
         log.fatal("No model specified (input_model=...)")
+    # serving path: native C++ predictor (predictor.hpp analogue) unless a
+    # feature it doesn't cover (early stop) is requested
+    from . import native
+    if native.available() and not cfg.pred_early_stop:
+        from .data.parser import load_text_file
+        X, _, _ = load_text_file(cfg.data, has_header=cfg.has_header,
+                                 label_idx=0)
+        pred = native.NativePredictor(model_file=cfg.input_model)
+        if cfg.is_predict_leaf_index:
+            preds = pred.predict_leaf(X, cfg.num_iteration_predict)
+        else:
+            preds = pred.predict(X, cfg.num_iteration_predict,
+                                 cfg.is_predict_raw_score)
+        out = np.asarray(preds).reshape(np.asarray(X).shape[0], -1)
+        np.savetxt(cfg.output_result, out, delimiter="\t", fmt="%.18g")
+        log.info("Finished prediction (native); results saved to %s",
+                 cfg.output_result)
+        return
     booster = Booster(model_file=cfg.input_model, params=params)
     preds = booster.predict(cfg.data,
                             num_iteration=cfg.num_iteration_predict,

@@ -285,6 +285,23 @@ class BinMapper:
                 out.ravel()[i] = self.categorical_2_bin[int(v)]
         return out
 
+    def bin_into(self, values: np.ndarray, out: np.ndarray) -> None:
+        """value_to_bin into a preallocated uint8/uint16 buffer, using the
+        native OpenMP binner when available (bin.h:451-483 either way)."""
+        from .. import native
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BIN_TYPE_NUMERICAL:
+            n_search = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+            nan_bin = self.num_bin - 1 if self.missing_type == MISSING_NAN else -1
+            if native.bin_column(values, self.bin_upper_bound, n_search,
+                                 nan_bin, out):
+                return
+        elif self.categorical_2_bin is not None:
+            if native.bin_column_categorical(values, self.categorical_2_bin,
+                                             self.num_bin - 1, out):
+                return
+        out[:] = self.value_to_bin(values).astype(out.dtype)
+
     def bin_to_value(self, bin_idx: int) -> float:
         """Representative real threshold for a bin (used in the model file)."""
         if self.bin_type == BIN_TYPE_CATEGORICAL:

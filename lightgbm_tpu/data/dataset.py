@@ -123,12 +123,14 @@ def construct(data: np.ndarray,
         if not ds.used_features:
             log.fatal("Cannot construct Dataset: all features are trivial (constant)")
 
-    # bin all columns
+    # bin all columns (native OpenMP binner when available)
     dtype = np.uint8 if ds.max_num_bin() <= 256 else np.uint16
     binned = np.empty((num_data, len(ds.used_features)), dtype=dtype)
+    col_buf = np.empty(num_data, dtype=dtype)
     for out_j, j in enumerate(ds.used_features):
-        binned[:, out_j] = ds.bin_mappers[j].value_to_bin(
-            np.asarray(data[:, j], dtype=np.float64)).astype(dtype)
+        ds.bin_mappers[j].bin_into(
+            np.asarray(data[:, j], dtype=np.float64), col_buf)
+        binned[:, out_j] = col_buf
     ds.binned = binned
 
     ds.metadata = Metadata(num_data)

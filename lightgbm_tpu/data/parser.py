@@ -55,13 +55,25 @@ def load_text_file(path: str, has_header: bool = False,
     fmt, _ = _sniff_format(head[start:] or head)
 
     header_names: Optional[List[str]] = None
+    if has_header and fmt != "libsvm":
+        sep_h = "," if fmt == "csv" else "\t"
+        header_names = [t.strip() for t in head[0].strip().split(sep_h)]
+
+    # native OpenMP parser fast path (same sniffing/NA semantics)
+    from .. import native
+    parsed = native.parse_file(path, has_header, label_idx) \
+        if native.available() else None
+    if parsed is not None:
+        features, labels = parsed
+        if header_names is not None and label_idx >= 0:
+            header_names = [h for i, h in enumerate(header_names)
+                            if i != label_idx]
+        return features, labels, header_names
+
     if fmt == "libsvm":
         return _load_libsvm(path, has_header, label_idx) + (None,)
 
     delim = "," if fmt == "csv" else None  # None -> any whitespace incl. tab
-    if has_header:
-        sep = "," if fmt == "csv" else "\t"
-        header_names = [t.strip() for t in head[0].strip().split(sep)]
 
     def conv(text: str) -> np.ndarray:
         return np.genfromtxt(io.StringIO(text), delimiter=delim,

@@ -1,0 +1,247 @@
+"""ctypes bindings for the native host runtime (gbt_native.cpp).
+
+The reference's data layer and serving path are C++ (parser.hpp, bin.cpp,
+predictor.hpp); this package provides the same split for the TPU framework:
+text parsing, value->bin quantization and model prediction run in an
+OpenMP-parallel shared library, while training compute stays on TPU.
+
+The library builds on demand with g++ (cached next to the source); when no
+toolchain is available every entry point degrades to the pure-python
+implementations, so the native layer is an accelerator, not a dependency.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "gbt_native.cpp")
+_LIB_PATH = os.path.join(_DIR, "_gbt_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-std=c++11", "-shared", "-fPIC", "-fopenmp",
+           _SRC, "-o", _LIB_PATH]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        # retry without OpenMP (toolchains without libgomp)
+        cmd = [c for c in cmd if c != "-fopenmp"]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=300)
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+    return proc.returncode == 0
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_ll, c_i, c_p = ctypes.c_longlong, ctypes.c_int, ctypes.c_void_p
+    c_d_p = ctypes.POINTER(ctypes.c_double)
+    c_f_p = ctypes.POINTER(ctypes.c_float)
+    c_i_p = ctypes.POINTER(ctypes.c_int)
+    c_ll_p = ctypes.POINTER(ctypes.c_longlong)
+
+    lib.GBTN_ParseFile.restype = c_p
+    lib.GBTN_ParseFile.argtypes = [ctypes.c_char_p, c_i, c_i]
+    lib.GBTN_ParsedRows.restype = c_ll
+    lib.GBTN_ParsedRows.argtypes = [c_p]
+    lib.GBTN_ParsedCols.restype = c_ll
+    lib.GBTN_ParsedCols.argtypes = [c_p]
+    lib.GBTN_ParsedError.restype = ctypes.c_char_p
+    lib.GBTN_ParsedError.argtypes = [c_p]
+    lib.GBTN_ParsedCopy.restype = None
+    lib.GBTN_ParsedCopy.argtypes = [c_p, c_d_p, c_f_p]
+    lib.GBTN_ParsedFree.restype = None
+    lib.GBTN_ParsedFree.argtypes = [c_p]
+
+    lib.GBTN_BinColumn.restype = None
+    lib.GBTN_BinColumn.argtypes = [c_d_p, c_ll, c_d_p, c_i, c_i, c_i, c_p]
+    lib.GBTN_BinColumnCategorical.restype = None
+    lib.GBTN_BinColumnCategorical.argtypes = [c_d_p, c_ll, c_ll_p, c_i_p,
+                                              c_i, c_i, c_i, c_p]
+
+    lib.GBTN_LoadModelString.restype = c_p
+    lib.GBTN_LoadModelString.argtypes = [ctypes.c_char_p]
+    lib.GBTN_LoadModelFile.restype = c_p
+    lib.GBTN_LoadModelFile.argtypes = [ctypes.c_char_p]
+    lib.GBTN_ModelError.restype = ctypes.c_char_p
+    lib.GBTN_ModelError.argtypes = [c_p]
+    lib.GBTN_ModelNumClass.restype = c_i
+    lib.GBTN_ModelNumClass.argtypes = [c_p]
+    lib.GBTN_ModelNumTrees.restype = c_i
+    lib.GBTN_ModelNumTrees.argtypes = [c_p]
+    lib.GBTN_ModelNumFeatures.restype = c_i
+    lib.GBTN_ModelNumFeatures.argtypes = [c_p]
+    lib.GBTN_Predict.restype = None
+    lib.GBTN_Predict.argtypes = [c_p, c_d_p, c_ll, c_i, c_i, c_i, c_d_p]
+    lib.GBTN_PredictLeaf.restype = None
+    lib.GBTN_PredictLeaf.argtypes = [c_p, c_d_p, c_ll, c_i, c_i, c_i_p]
+    lib.GBTN_FreeModel.restype = None
+    lib.GBTN_FreeModel.argtypes = [c_p]
+    lib.GBTN_OpenMPThreads.restype = c_i
+    lib.GBTN_OpenMPThreads.argtypes = []
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("LGBM_TPU_NO_NATIVE"):
+            _load_failed = True
+            return None
+        try:
+            if (not os.path.exists(_LIB_PATH)
+                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+                if not _build():
+                    _load_failed = True
+                    return None
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _load_failed = True
+            return None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------- wrappers
+
+def parse_file(path: str, has_header: bool, label_idx: int
+               ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native text parse -> (features [N, F] f64, labels [N] f32)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    h = lib.GBTN_ParseFile(path.encode(), int(has_header), int(label_idx))
+    try:
+        err = lib.GBTN_ParsedError(h)
+        if err:
+            raise ValueError(f"native parser: {err.decode()}")
+        n, f = lib.GBTN_ParsedRows(h), lib.GBTN_ParsedCols(h)
+        feats = np.empty((n, f), dtype=np.float64)
+        labels = np.empty((n,), dtype=np.float32)
+        lib.GBTN_ParsedCopy(
+            h, feats.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return feats, labels
+    finally:
+        lib.GBTN_ParsedFree(h)
+
+
+def bin_column(values: np.ndarray, bounds: np.ndarray, n_search: int,
+               nan_bin: int, out: np.ndarray) -> bool:
+    """Native numerical value->bin into preallocated uint8/uint16 ``out``."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    bounds = np.ascontiguousarray(bounds, dtype=np.float64)
+    bits = 8 if out.dtype == np.uint8 else 16
+    lib.GBTN_BinColumn(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(values),
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        int(n_search), int(nan_bin), bits, out.ctypes.data_as(ctypes.c_void_p))
+    return True
+
+
+def bin_column_categorical(values: np.ndarray, cat_to_bin: dict,
+                           overflow_bin: int, out: np.ndarray) -> bool:
+    lib = get_lib()
+    if lib is None:
+        return False
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    cats = np.asarray(sorted(cat_to_bin), dtype=np.int64)
+    bins = np.asarray([cat_to_bin[c] for c in cats], dtype=np.int32)
+    bits = 8 if out.dtype == np.uint8 else 16
+    lib.GBTN_BinColumnCategorical(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(values),
+        cats.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        bins.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        len(cats), int(overflow_bin), bits,
+        out.ctypes.data_as(ctypes.c_void_p))
+    return True
+
+
+class NativePredictor:
+    """Native model predictor (serving path; predictor.hpp analogue)."""
+
+    def __init__(self, model_str: Optional[str] = None,
+                 model_file: Optional[str] = None):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        if model_file is not None:
+            self._h = lib.GBTN_LoadModelFile(model_file.encode())
+        else:
+            self._h = lib.GBTN_LoadModelString(model_str.encode())
+        err = lib.GBTN_ModelError(self._h)
+        if err:
+            msg = err.decode()
+            lib.GBTN_FreeModel(self._h)
+            self._h = None
+            raise ValueError(f"native model load: {msg}")
+        self.num_class = lib.GBTN_ModelNumClass(self._h)
+        self.num_trees = lib.GBTN_ModelNumTrees(self._h)
+        self.num_features = lib.GBTN_ModelNumFeatures(self._h)
+
+    def _prepare(self, X: np.ndarray) -> np.ndarray:
+        """Contiguous f64 matrix padded/validated to the model's feature
+        count (sparse prediction files may have fewer trailing columns)."""
+        X = np.ascontiguousarray(np.atleast_2d(X), dtype=np.float64)
+        f = X.shape[1]
+        if f < self.num_features:
+            X = np.pad(X, ((0, 0), (0, self.num_features - f)))
+        elif f > self.num_features:
+            X = np.ascontiguousarray(X[:, :self.num_features])
+        return X
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1,
+                raw_score: bool = False) -> np.ndarray:
+        X = self._prepare(X)
+        n, f = X.shape
+        k = max(self.num_class, 1)
+        out = np.empty((n, k), dtype=np.float64)
+        self._lib.GBTN_Predict(
+            self._h, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            n, f, int(num_iteration), int(raw_score),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return out[:, 0] if k == 1 else out
+
+    def predict_leaf(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        X = self._prepare(X)
+        n, f = X.shape
+        k = max(self.num_class, 1)
+        iters = self.num_trees // k if k else 0
+        if num_iteration > 0:
+            iters = min(num_iteration, iters)
+        total = iters * k
+        out = np.empty((n, total), dtype=np.int32)
+        self._lib.GBTN_PredictLeaf(
+            self._h, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            n, f, int(num_iteration),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+        return out
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None:
+            self._lib.GBTN_FreeModel(self._h)

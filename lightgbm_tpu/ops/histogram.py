@@ -79,4 +79,6 @@ def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     if method == "pallas":
         from .pallas_hist import subset_histogram_pallas
         return subset_histogram_pallas(rows, g, h, c, num_bins)
-    return subset_histogram_einsum(rows, g, h, c, num_bins)
+    if method == "einsum":
+        return subset_histogram_einsum(rows, g, h, c, num_bins)
+    raise ValueError(f"unknown histogram method {method!r}")

@@ -9,8 +9,10 @@ the MXU.  The [rows, features*bins] one-hot never exists in HBM — only the
 [feature_tile, B, 6] accumulator block does, revisited across row tiles.
 
 Layout: bins come in transposed ``[F, N]`` so the row dimension is the lane
-axis of each block.  Weights ``w [N, 6]`` carry (g, h, c) for the left and
-right child, premasked by segment outside the kernel (fused by XLA).
+axis of each block.  Weights ``w_t [6, N]`` carry the bf16 channels
+``(g_hi, g_lo, h_hi, h_lo, c, 0)`` — gradients/hessians are hi/lo-split so a
+single-pass bf16 MXU dot accumulates with ~f32 accuracy (recombined by the
+caller, ``subset_histogram_pallas``).
 """
 from __future__ import annotations
 
@@ -22,7 +24,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NUM_CH = 6  # (g, h, c) x (left child, right child)
+NUM_CH = 6  # weight channels: (g_hi, g_lo, h_hi, h_lo, c, unused)
 
 
 def _hist_kernel(bins_ref, w_ref, out_ref, *, num_bins: int, feat_tile: int):

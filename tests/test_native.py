@@ -1,0 +1,127 @@
+"""Native C++ host runtime parity tests (gbt_native.cpp vs the pure-python
+paths): parser, binner, predictor — the same backend-parity discipline as
+the reference's GPU_DEBUG_COMPARE (gpu_tree_learner.cpp:1018-1043)."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import native
+from lightgbm_tpu.data.binning import BinMapper, MISSING_NAN
+from lightgbm_tpu.data.parser import load_text_file
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_parser_parity_tsv(tmp_path):
+    rng = np.random.RandomState(0)
+    mat = rng.randn(200, 5)
+    path = tmp_path / "data.tsv"
+    with open(path, "w") as f:
+        for row in mat:
+            f.write("\t".join(f"{v:.10g}" for v in row) + "\n")
+    feats, labels = native.parse_file(str(path), False, 0)
+    np.testing.assert_allclose(feats, mat[:, 1:], rtol=1e-9)
+    np.testing.assert_allclose(labels, mat[:, 0].astype(np.float32))
+
+
+def test_parser_parity_csv_header_missing(tmp_path):
+    path = tmp_path / "data.csv"
+    with open(path, "w") as f:
+        f.write("label,a,b\n1,2.5,3\n0,,na\n1,7,8\n")
+    feats, labels, names = load_text_file(str(path), has_header=True,
+                                          label_idx=0)
+    assert names == ["a", "b"]
+    np.testing.assert_allclose(labels, [1, 0, 1])
+    assert feats[0, 0] == 2.5
+    assert np.isnan(feats[1, 0]) and np.isnan(feats[1, 1])
+
+
+def test_parser_parity_libsvm(tmp_path):
+    path = tmp_path / "data.svm"
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.5\n0 1:4.0\n1 2:-1 3:0.5\n")
+    feats, labels = native.parse_file(str(path), False, 0)
+    assert feats.shape == (3, 4)
+    assert feats[0, 0] == 1.5 and feats[0, 3] == 2.5
+    assert feats[1, 1] == 4.0 and feats[1, 0] == 0.0
+    np.testing.assert_allclose(labels, [1, 0, 1])
+
+
+def test_bin_column_parity():
+    rng = np.random.RandomState(1)
+    v = rng.randn(50000)
+    v[::13] = np.nan
+    v[::7] = 0.0
+    m = BinMapper.fit(v[~np.isnan(v)], len(v), 63, 3, 2)
+    ref = m.value_to_bin(v)
+    out = np.empty(len(v), np.uint8)
+    n_search = m.num_bin - (1 if m.missing_type == MISSING_NAN else 0)
+    nan_bin = m.num_bin - 1 if m.missing_type == MISSING_NAN else -1
+    assert native.bin_column(v, m.bin_upper_bound, n_search, nan_bin, out)
+    np.testing.assert_array_equal(ref, out)
+
+
+def test_bin_into_categorical_parity():
+    rng = np.random.RandomState(2)
+    v = rng.randint(0, 30, size=10000).astype(np.float64)
+    v[::11] = np.nan
+    from lightgbm_tpu.data.binning import BIN_TYPE_CATEGORICAL
+    m = BinMapper.fit(v[~np.isnan(v)], len(v), 32, 1, 1,
+                      bin_type=BIN_TYPE_CATEGORICAL)
+    ref = m.value_to_bin(v)
+    out = np.empty(len(v), np.uint8)
+    m.bin_into(v, out)
+    np.testing.assert_array_equal(ref, out)
+
+
+@pytest.fixture(scope="module")
+def model_and_data(binary_example):
+    X, y, Xt, yt = binary_example
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                    d, 20, verbose_eval=False)
+    return bst, Xt
+
+
+def test_native_predictor_parity(model_and_data):
+    bst, Xt = model_and_data
+    pred = native.NativePredictor(model_str=bst.model_to_string())
+    np.testing.assert_allclose(pred.predict(Xt), bst.predict(Xt), rtol=1e-10)
+    np.testing.assert_allclose(pred.predict(Xt, raw_score=True),
+                               bst.predict(Xt, raw_score=True), rtol=1e-10)
+    np.testing.assert_array_equal(pred.predict_leaf(Xt[:200]),
+                                  bst.predict(Xt[:200], pred_leaf=True))
+    # num_iteration truncation
+    np.testing.assert_allclose(pred.predict(Xt[:100], num_iteration=5),
+                               bst.predict(Xt[:100], num_iteration=5),
+                               rtol=1e-10)
+
+
+def test_native_predictor_file_roundtrip(model_and_data, tmp_path):
+    bst, Xt = model_and_data
+    path = tmp_path / "m.txt"
+    bst.save_model(str(path))
+    pred = native.NativePredictor(model_file=str(path))
+    np.testing.assert_allclose(pred.predict(Xt), bst.predict(Xt), rtol=1e-10)
+
+
+def test_native_predictor_multiclass():
+    rng = np.random.RandomState(3)
+    X = rng.randn(2000, 6)
+    y = (X[:, 0] * 2 + X[:, 1] > 0).astype(int) + (X[:, 2] > 0.5).astype(int)
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbose": -1}, d, 10,
+                    verbose_eval=False)
+    pred = native.NativePredictor(model_str=bst.model_to_string())
+    np.testing.assert_allclose(pred.predict(X[:300]), bst.predict(X[:300]),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_native_model_error():
+    with pytest.raises(ValueError):
+        native.NativePredictor(model_str="tree\nnum_class=1\nTree=0\n"
+                                         "num_leaves=3\nleaf_value=1\n")
