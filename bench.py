@@ -44,12 +44,14 @@ def main():
     from lightgbm_tpu.objectives import create_objective
     from lightgbm_tpu.boosting import create_boosting
 
+    from lightgbm_tpu.utils import log as _log
+    _log.set_verbosity(-1)
     platform = jax.devices()[0].platform
     X, y = make_data(n_rows)
     params = {
         "objective": "binary",
-        "num_leaves": 255,
-        "max_bin": 255,
+        "num_leaves": int(os.environ.get("BENCH_LEAVES", 255)),
+        "max_bin": int(os.environ.get("BENCH_MAX_BIN", 255)),
         "min_data_in_leaf": 1,
         "min_sum_hessian_in_leaf": 100,
         "learning_rate": 0.1,

@@ -33,6 +33,7 @@ from .predictor import Predictor, tree_scores_binned
 from .tree import Tree
 from .utils import log
 from .utils.random import make_rng
+from .utils.timer import PhaseTimers
 
 
 class _ValidSet:
@@ -62,6 +63,7 @@ class GBDT:
         self.train_set = train_set
         self.objective = objective
         self.models: List[Tree] = []
+        self.timers = PhaseTimers()   # TIMETAG analogue (gbdt.cpp:22-64)
         self.iter_ = 0
         self.num_init_iteration = 0
         self.boost_from_average_ = False
@@ -253,12 +255,18 @@ class GBDT:
                 and not self.boost_from_average_):
             self._boost_from_average()
 
-        if grad is None or hess is None:
-            g, h = self._grad_fn(self.scores)
-        else:
-            g = jnp.asarray(grad, jnp.float32).reshape(self.num_class, -1)
-            h = jnp.asarray(hess, jnp.float32).reshape(self.num_class, -1)
-        g, h, cnt = self._sample(self.iter_, g, h)
+        # each phase blocks on its outputs so async dispatch does not
+        # misattribute device time to the next phase
+        with self.timers.phase("boosting"):
+            if grad is None or hess is None:
+                g, h = self._grad_fn(self.scores)
+            else:
+                g = jnp.asarray(grad, jnp.float32).reshape(self.num_class, -1)
+                h = jnp.asarray(hess, jnp.float32).reshape(self.num_class, -1)
+            jax.block_until_ready((g, h))
+        with self.timers.phase("bagging"):
+            g, h, cnt = self._sample(self.iter_, g, h)
+            jax.block_until_ready((g, h, cnt))
 
         lr = self._shrinkage_rate()
         any_split = False
@@ -272,27 +280,30 @@ class GBDT:
             return jnp.pad(x, (0, self._row_pad)) if self._row_pad else x
 
         for k in range(self.num_class):
-            arrays, row_leaf = self.grow(self.bins,
-                                         padded(g[k] * self._bag_weight),
-                                         padded(h[k] * self._bag_weight),
-                                         padded(cnt), self.meta, feat_mask)
-            if self._row_pad:
-                row_leaf = row_leaf[:self.num_data]
-            num_leaves = int(arrays.num_leaves)
-            tree = Tree.from_arrays(arrays, self.train_set.used_features,
-                                    self.train_set.bin_mappers,
-                                    np.asarray(self.meta.num_bin))
-            tree.shrink(lr)
-            self.models.append(tree)
+            with self.timers.phase("tree"):
+                arrays, row_leaf = self.grow(self.bins,
+                                             padded(g[k] * self._bag_weight),
+                                             padded(h[k] * self._bag_weight),
+                                             padded(cnt), self.meta, feat_mask)
+                if self._row_pad:
+                    row_leaf = row_leaf[:self.num_data]
+                num_leaves = int(arrays.num_leaves)
+                tree = Tree.from_arrays(arrays, self.train_set.used_features,
+                                        self.train_set.bin_mappers,
+                                        np.asarray(self.meta.num_bin))
+                tree.shrink(lr)
+                self.models.append(tree)
             if num_leaves > 1:
                 any_split = True
-                self.scores = self.scores.at[k].set(self._update_score(
-                    self.scores[k], arrays.leaf_value, row_leaf,
-                    jnp.asarray(lr, jnp.float32)))
-                for vs in self.valid_sets:
-                    vs.scores = vs.scores.at[k].add(tree_scores_binned(
-                        vs.bins, tree, self.used_feature_index, self.feat_info,
-                        self.train_set.bin_mappers))
+                with self.timers.phase("score"):
+                    self.scores = self.scores.at[k].set(self._update_score(
+                        self.scores[k], arrays.leaf_value, row_leaf,
+                        jnp.asarray(lr, jnp.float32)))
+                    for vs in self.valid_sets:
+                        vs.scores = vs.scores.at[k].add(tree_scores_binned(
+                            vs.bins, tree, self.used_feature_index,
+                            self.feat_info, self.train_set.bin_mappers))
+                    jax.block_until_ready(self.scores)
         self._after_iter()
         self.iter_ += 1
         if not any_split:
@@ -351,6 +362,10 @@ class GBDT:
         return out
 
     def _eval(self, name, metrics, scores) -> List[Tuple[str, str, float, bool]]:
+        with self.timers.phase("metric"):
+            return self._eval_inner(name, metrics, scores)
+
+    def _eval_inner(self, name, metrics, scores) -> List[Tuple[str, str, float, bool]]:
         results = []
         for m in metrics:
             vals = m.eval(scores, self.objective)

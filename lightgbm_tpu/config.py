@@ -203,6 +203,7 @@ class Config:
     input_model: str = ""
     output_result: str = "LightGBM_predict_result.txt"
     snapshot_freq: int = -1
+    profile_dir: str = ""          # write a jax.profiler trace of training here
     convert_model: str = "gbdt_prediction.cpp"
     convert_model_language: str = ""
 

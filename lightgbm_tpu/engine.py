@@ -90,40 +90,51 @@ def train(params: Dict[str, Any], train_set: Dataset,
     snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
     snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
 
-    for i in range(num_boost_round):
-        for cb in cbs_before:
-            cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                        iteration=i, begin_iteration=0,
-                                        end_iteration=num_boost_round,
-                                        evaluation_result_list=None))
-        finished = booster.update(fobj=fobj)
+    # jax.profiler trace of the boosting loop (the reference's TIMETAG deep
+    # profile becomes an xprof trace; lightweight counters are always on)
+    profile_dir = params.get("profile_dir")
+    import contextlib
+    profile_ctx = contextlib.nullcontext()
+    if profile_dir:
+        import jax
+        profile_ctx = jax.profiler.trace(str(profile_dir))
 
-        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
-            # gbdt.cpp:456-460: periodic model snapshots during training
-            booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
+    with profile_ctx:
+        for i in range(num_boost_round):
+            for cb in cbs_before:
+                cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                            iteration=i, begin_iteration=0,
+                                            end_iteration=num_boost_round,
+                                            evaluation_result_list=None))
+            finished = booster.update(fobj=fobj)
 
-        evaluation_result_list = []
-        if valid_sets:
-            if is_valid_contain_train:
-                evaluation_result_list.extend(
-                    (train_data_name, m, v, hib)
-                    for (_, m, v, hib) in booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in cbs_after:
-                cb(callback_mod.CallbackEnv(
-                    model=booster, params=params, iteration=i,
-                    begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as es:
-            booster.best_iteration = es.best_iteration + 1
-            for item in (es.best_score or []):
-                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-            break
-        if finished:
-            break
+            if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+                # gbdt.cpp:456-460: periodic model snapshots during training
+                booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
+
+            evaluation_result_list = []
+            if valid_sets:
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(
+                        (train_data_name, m, v, hib)
+                        for (_, m, v, hib) in booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in cbs_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=0, end_iteration=num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                for item in (es.best_score or []):
+                    booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+                break
+            if finished:
+                break
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
+    booster.inner.timers.report("training phase timers")
     return booster
 
 

@@ -1,0 +1,49 @@
+"""Always-on lightweight phase timers.
+
+The reference accumulates per-phase ``std::chrono`` counters under
+``#ifdef TIMETAG`` (``serial_tree_learner.cpp:10-37``, ``gbdt.cpp:22-64``)
+and dumps them at destruction.  Here the counters are always on (the cost is
+one clock read per phase) and reported through the logger; deep kernel-level
+profiles come from ``jax.profiler`` instead (see ``engine.train``'s
+``profile_dir`` parameter).
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from typing import Dict
+
+from . import log
+
+
+class PhaseTimers:
+    """Accumulating wall-clock counters keyed by phase name."""
+
+    def __init__(self):
+        self.seconds: Dict[str, float] = collections.defaultdict(float)
+        self.counts: Dict[str, int] = collections.defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def add(self, name: str, seconds: float) -> None:
+        self.seconds[name] += seconds
+        self.counts[name] += 1
+
+    def report(self, header: str = "phase timers") -> str:
+        parts = [f"{k}: {v:.3f}s/{self.counts[k]}x"
+                 for k, v in sorted(self.seconds.items(), key=lambda kv: -kv[1])]
+        text = f"{header}: " + ", ".join(parts) if parts else f"{header}: (empty)"
+        log.debug("%s", text)
+        return text
+
+    def reset(self) -> None:
+        self.seconds.clear()
+        self.counts.clear()
