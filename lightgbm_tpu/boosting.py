@@ -86,14 +86,21 @@ class GBDT:
         cfg = self.config
         self.bins = jnp.asarray(train.binned)
         fm = train.feature_meta()
+        bundled = "col" in fm
         self.meta = FeatureMeta(
             num_bin=jnp.asarray(fm["num_bin"]),
             missing_type=jnp.asarray(fm["missing_type"]),
             default_bin=jnp.asarray(fm["default_bin"]),
-            is_categorical=jnp.asarray(fm["is_categorical"]))
+            is_categorical=jnp.asarray(fm["is_categorical"]),
+            col=jnp.asarray(fm["col"]) if bundled else None,
+            offset=jnp.asarray(fm["offset"]) if bundled else None)
+        e = len(fm["num_bin"])
+        col = fm["col"] if bundled else np.arange(e, dtype=np.int32)
+        off = fm["offset"] if bundled else np.full(e, -1, np.int32)
         self.feat_info = jnp.stack(
             [jnp.asarray(fm["num_bin"]), jnp.asarray(fm["missing_type"]),
-             jnp.asarray(fm["default_bin"])], axis=1)
+             jnp.asarray(fm["default_bin"]), jnp.asarray(col),
+             jnp.asarray(off)], axis=1)
         self.used_feature_index = {f: i for i, f in enumerate(train.used_features)}
         self.num_data = train.num_data
         n = self.num_data

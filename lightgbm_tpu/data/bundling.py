@@ -1,0 +1,154 @@
+"""Exclusive Feature Bundling (EFB).
+
+Re-designs the reference's greedy conflict-bounded bundling
+(``FindGroups`` / ``FastFeatureBundling``, ``src/io/dataset.cpp:66-210``) for
+the dense TPU layout: mutually-exclusive sparse features merge into ONE
+physical uint8/16 column, so histogram width shrinks with the number of
+*bundles*, not raw features — the same reduction the reference gets from
+multi-feature ``FeatureGroup`` bins.
+
+Layout per bundle column:
+* slot 0 — every bundled feature at its default bin ("all zero");
+* feature f with ``num_bin`` bins and default bin ``db`` owns the contiguous
+  slot range ``[offset_f, offset_f + num_bin - 2]``: its non-default bins in
+  ascending order with ``db`` skipped (``slot = offset + b - (b > db)``).
+
+Rows where two bundled features are simultaneously non-default are conflicts;
+the greedy packer bounds them by ``max_conflict_rate`` exactly like the
+reference (later features overwrite earlier ones on conflicting rows).
+
+Split finding never sees bundle columns directly: the grower's ``find``
+expands a bundle histogram into per-subfeature histograms, reconstructing
+each feature's default-bin entry as ``parent - sum(own slots)`` — the
+reference's ``FixHistogram`` (``dataset.cpp:749-768``) in tensor form.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+
+
+def find_bundles(nonzero: np.ndarray,            # [S, F] bool sample matrix
+                 num_bins: Sequence[int],        # per feature
+                 max_conflict_rate: float,
+                 max_bundle_bins: int = 256,
+                 max_sparse_rate: float = 0.8) -> List[List[int]]:
+    """Greedy first-fit bundling (FindGroups, dataset.cpp:66-136 semantics).
+
+    Returns a list of bundles (lists of feature indices into the input
+    ordering); singleton lists are unbundled features.  Features denser than
+    ``max_sparse_rate`` never bundle.
+    """
+    s, f = nonzero.shape
+    nz_cnt = nonzero.sum(axis=0)
+    budget = max_conflict_rate * s
+    order = np.argsort(-nz_cnt, kind="mergesort")  # densest first (stable)
+
+    bundles: List[List[int]] = []
+    bundle_rows: List[np.ndarray] = []    # union of nonzero rows per bundle
+    bundle_conflicts: List[float] = []
+    bundle_bins: List[int] = []
+
+    for j in order:
+        nb = int(num_bins[j])
+        sparse_ok = s == 0 or nz_cnt[j] <= max_sparse_rate * s
+        placed = False
+        if sparse_ok:
+            for gi in range(len(bundles)):
+                extra_bins = nb - 1
+                if bundle_bins[gi] + extra_bins > max_bundle_bins:
+                    continue
+                conflicts = int(np.count_nonzero(bundle_rows[gi] & nonzero[:, j]))
+                if bundle_conflicts[gi] + conflicts <= budget:
+                    bundles[gi].append(int(j))
+                    bundle_rows[gi] |= nonzero[:, j]
+                    bundle_conflicts[gi] += conflicts
+                    bundle_bins[gi] += extra_bins
+                    placed = True
+                    break
+        if not placed:
+            if sparse_ok and nb <= max_bundle_bins:
+                bundles.append([int(j)])
+                bundle_rows.append(nonzero[:, j].copy())
+                bundle_conflicts.append(0.0)
+                bundle_bins.append(1 + (nb - 1))
+            else:
+                # dense / oversized feature: its own column, never joined
+                bundles.append([int(j)])
+                bundle_rows.append(np.ones(s, dtype=bool))
+                bundle_conflicts.append(float("inf"))
+                bundle_bins.append(max_bundle_bins + 1)
+    # restore deterministic order: bundles sorted by their first feature
+    for b in bundles:
+        b.sort()
+    bundles.sort(key=lambda b: b[0])
+    return bundles
+
+
+class BundleLayout:
+    """Per-logical-feature decode tables for a bundled dataset.
+
+    Logical (sub)features are the original used features, in bundle order;
+    physical columns are the binned matrix's columns (one per bundle).
+    """
+
+    def __init__(self, bundles: List[List[int]], mappers, used: List[int]):
+        # bundles contain ORIGINAL feature ids; `used` lists them in logical
+        # (expansion) order
+        self.bundles = bundles
+        self.sub_features: List[int] = []  # original id per logical feature
+        self.sub_col: List[int] = []       # physical column
+        self.sub_offset: List[int] = []    # first slot (-1: unbundled)
+        self.col_num_bin: List[int] = []   # physical bins per column
+        for col, bundle in enumerate(bundles):
+            if len(bundle) == 1:
+                j = bundle[0]
+                self.sub_features.append(j)
+                self.sub_col.append(col)
+                self.sub_offset.append(-1)
+                self.col_num_bin.append(mappers[j].num_bin)
+            else:
+                offset = 1
+                for j in bundle:
+                    self.sub_features.append(j)
+                    self.sub_col.append(col)
+                    self.sub_offset.append(offset)
+                    offset += mappers[j].num_bin - 1
+                self.col_num_bin.append(offset)
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.bundles)
+
+    @property
+    def has_bundles(self) -> bool:
+        return any(len(b) > 1 for b in self.bundles)
+
+    def max_col_bins(self) -> int:
+        return max(self.col_num_bin) if self.col_num_bin else 1
+
+
+def build_bundled_column(data: np.ndarray, bundle: List[int], mappers,
+                         offsets: List[int], dtype,
+                         bin_buf: Optional[np.ndarray] = None) -> np.ndarray:
+    """Bin + merge one bundle's features into a single column.
+
+    ``offsets[i]`` is the first slot of ``bundle[i]``; conflicting rows take
+    the LAST feature's value (the reference also resolves conflicts by
+    overwrite, PushData order)."""
+    n = data.shape[0]
+    col = np.zeros(n, dtype=dtype)
+    if bin_buf is None:
+        bin_buf = np.empty(n, dtype=dtype)
+    for j, off in zip(bundle, offsets):
+        m = mappers[j]
+        m.bin_into(np.asarray(data[:, j], dtype=np.float64), bin_buf)
+        b = bin_buf.astype(np.int32)
+        db = m.default_bin
+        nondef = b != db
+        slot = off + b - (b > db)
+        col[nondef] = slot[nondef].astype(dtype)
+    return col

@@ -23,6 +23,7 @@ from ..utils import log
 from ..utils.random import make_rng, sample_k
 from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
                       MISSING_NAN, MISSING_NONE, MISSING_ZERO)
+from .bundling import BundleLayout, build_bundled_column, find_bundles
 from .metadata import Metadata
 
 
@@ -33,8 +34,9 @@ class TrainingData:
         self.num_data: int = 0
         self.num_total_features: int = 0
         self.bin_mappers: List[BinMapper] = []
-        self.used_features: List[int] = []         # original feature index per used column
-        self.binned: Optional[np.ndarray] = None   # [N, F_used] uint8/uint16
+        self.used_features: List[int] = []         # original feature index per LOGICAL column
+        self.binned: Optional[np.ndarray] = None   # [N, F_physical] uint8/uint16
+        self.layout: Optional[BundleLayout] = None  # EFB layout (None: 1:1)
         self.metadata: Metadata = Metadata()
         self.feature_names: List[str] = []
         self.reference: Optional["TrainingData"] = None
@@ -46,16 +48,24 @@ class TrainingData:
         return len(self.used_features)
 
     def feature_meta(self) -> Dict[str, np.ndarray]:
+        """Per-LOGICAL-feature meta (+ bundle decode maps when EFB is on)."""
         mappers = [self.bin_mappers[i] for i in self.used_features]
-        return {
+        out = {
             "num_bin": np.asarray([m.num_bin for m in mappers], dtype=np.int32),
             "missing_type": np.asarray([m.missing_type for m in mappers], dtype=np.int32),
             "default_bin": np.asarray([m.default_bin for m in mappers], dtype=np.int32),
             "is_categorical": np.asarray(
                 [m.bin_type == BIN_TYPE_CATEGORICAL for m in mappers], dtype=bool),
         }
+        if self.layout is not None and self.layout.has_bundles:
+            out["col"] = np.asarray(self.layout.sub_col, dtype=np.int32)
+            out["offset"] = np.asarray(self.layout.sub_offset, dtype=np.int32)
+        return out
 
     def max_num_bin(self) -> int:
+        """Histogram width: max bins over PHYSICAL columns."""
+        if self.layout is not None and self.layout.has_bundles:
+            return self.layout.max_col_bins()
         if not self.used_features:
             return 1
         return max(self.bin_mappers[i].num_bin for i in self.used_features)
@@ -94,6 +104,7 @@ def construct(data: np.ndarray,
         ds.bin_mappers = reference.bin_mappers
         ds.used_features = reference.used_features
         ds.feature_names = reference.feature_names
+        ds.layout = reference.layout
         if num_features != reference.num_total_features:
             log.fatal("Validation data has %d features, training data has %d",
                       num_features, reference.num_total_features)
@@ -123,14 +134,52 @@ def construct(data: np.ndarray,
         if not ds.used_features:
             log.fatal("Cannot construct Dataset: all features are trivial (constant)")
 
+        # EFB: greedily bundle mutually-exclusive sparse features
+        # (FindGroups/FastFeatureBundling, dataset.cpp:66-210); the feature-
+        # and voting-parallel learners scan per-feature vote/slice sets, so
+        # bundling is enabled for the serial and data-parallel learners only
+        if (config.enable_bundle and len(ds.used_features) > 1
+                and config.tree_learner in ("serial", "data")):
+            bs = sample[:min(len(sample), 20000)]
+            nonzero = np.zeros((bs.shape[0], len(ds.used_features)), dtype=bool)
+            for k, j in enumerate(ds.used_features):
+                colv = bs[:, j]
+                nonzero[:, k] = (colv != 0) | np.isnan(colv)
+            bundles_local = find_bundles(
+                nonzero,
+                [ds.bin_mappers[j].num_bin for j in ds.used_features],
+                config.max_conflict_rate)
+            bundles = [[ds.used_features[k] for k in b] for b in bundles_local]
+            layout = BundleLayout(bundles, ds.bin_mappers, ds.used_features)
+            if layout.has_bundles:
+                ds.layout = layout
+                ds.used_features = layout.sub_features
+                log.info("EFB bundled %d features into %d columns",
+                         len(layout.sub_features), layout.num_columns)
+
     # bin all columns (native OpenMP binner when available)
     dtype = np.uint8 if ds.max_num_bin() <= 256 else np.uint16
-    binned = np.empty((num_data, len(ds.used_features)), dtype=dtype)
     col_buf = np.empty(num_data, dtype=dtype)
-    for out_j, j in enumerate(ds.used_features):
-        ds.bin_mappers[j].bin_into(
-            np.asarray(data[:, j], dtype=np.float64), col_buf)
-        binned[:, out_j] = col_buf
+    if ds.layout is not None and ds.layout.has_bundles:
+        lay = ds.layout
+        binned = np.empty((num_data, lay.num_columns), dtype=dtype)
+        for col, bundle in enumerate(lay.bundles):
+            if len(bundle) == 1:
+                ds.bin_mappers[bundle[0]].bin_into(
+                    np.asarray(data[:, bundle[0]], dtype=np.float64), col_buf)
+                binned[:, col] = col_buf
+            else:
+                offsets = [lay.sub_offset[k]
+                           for k in range(len(lay.sub_col))
+                           if lay.sub_col[k] == col]
+                binned[:, col] = build_bundled_column(
+                    data, bundle, ds.bin_mappers, offsets, dtype, col_buf)
+    else:
+        binned = np.empty((num_data, len(ds.used_features)), dtype=dtype)
+        for out_j, j in enumerate(ds.used_features):
+            ds.bin_mappers[j].bin_into(
+                np.asarray(data[:, j], dtype=np.float64), col_buf)
+            binned[:, out_j] = col_buf
     ds.binned = binned
 
     ds.metadata = Metadata(num_data)

@@ -89,11 +89,33 @@ class TreeArrays(NamedTuple):
 
 
 class FeatureMeta(NamedTuple):
-    """Per-used-feature static metadata as device arrays."""
-    num_bin: jnp.ndarray       # [F] i32
-    missing_type: jnp.ndarray  # [F] i32 (0 none / 1 zero / 2 nan)
-    default_bin: jnp.ndarray   # [F] i32
-    is_categorical: jnp.ndarray  # [F] bool
+    """Per-LOGICAL-feature static metadata as device arrays.
+
+    With EFB (``data/bundling.py``) several logical features share one
+    physical binned column; ``col``/``offset`` carry the decode maps
+    (both None when the dataset is unbundled and columns are 1:1)."""
+    num_bin: jnp.ndarray       # [E] i32
+    missing_type: jnp.ndarray  # [E] i32 (0 none / 1 zero / 2 nan)
+    default_bin: jnp.ndarray   # [E] i32
+    is_categorical: jnp.ndarray  # [E] bool
+    col: jnp.ndarray = None    # [E] i32 physical column (None: identity)
+    offset: jnp.ndarray = None  # [E] i32 first bundle slot (-1: unbundled)
+
+
+def decode_bundle_bin(raw, feat, meta: FeatureMeta):
+    """Physical column bin -> logical sub-feature bin for feature ``feat``.
+
+    Bundle slot layout (bundling.py): slot 0 = all-default; feature f owns
+    slots [offset, offset + num_bin - 2] (its bins minus the default bin, in
+    order).  Out-of-range slots mean "another feature is active" -> f sits in
+    its default bin — the sparse-bin semantics of the reference FeatureGroup."""
+    off = meta.offset[feat]
+    nb = meta.num_bin[feat]
+    db = meta.default_bin[feat]
+    local = raw - off
+    in_range = (local >= 0) & (local < nb - 1)
+    sub = jnp.where(in_range, local + (local >= db).astype(raw.dtype), db)
+    return jnp.where(off < 0, raw, sub)
 
 
 class _LoopState(NamedTuple):
@@ -131,7 +153,9 @@ class SerialStrategy:
         self.cfg = cfg
 
     def setup(self, bins, meta: FeatureMeta, feat_valid):
-        return (meta, feat_valid)
+        maps = (make_expand_maps(meta, self.cfg.max_bin)
+                if meta.col is not None else None)
+        return (meta, feat_valid, maps)
 
     def hist_bins(self, ctx, bins):
         return bins
@@ -140,13 +164,51 @@ class SerialStrategy:
         return hist
 
     def find(self, ctx, hist, pg, ph, pc):
-        meta, feat_valid = ctx
+        meta, feat_valid, maps = ctx
+        if maps is not None:
+            hist = expand_bundle_hist(hist, pg, ph, pc, maps)
         return best_split(hist, pg, ph, pc, meta.num_bin,
                           meta.missing_type, meta.default_bin, feat_valid,
                           self.cfg.split_config(), is_cat=meta.is_categorical)
 
     def reduce_scalar(self, x):
         return x
+
+
+def make_expand_maps(meta: FeatureMeta, num_bins: int):
+    """Gather/reconstruction maps for expanding physical (bundle) histograms
+    into per-logical-feature histograms (FixHistogram in tensor form,
+    dataset.cpp:749-768).  All entries are traced jnp ops over the meta."""
+    b = jnp.arange(num_bins, dtype=jnp.int32)[None, :]          # [1, B]
+    off = meta.offset[:, None]
+    nb = meta.num_bin[:, None]
+    db = meta.default_bin[:, None]
+    c = meta.col[:, None]
+    slot = off + b - (b > db).astype(jnp.int32)
+    src = jnp.where(off < 0, c * num_bins + b,
+                    c * num_bins + jnp.clip(slot, 0, num_bins - 1))
+    valid = b < nb
+    recon = (off >= 0) & (b == db) & valid
+    lo = jnp.maximum((c * num_bins + off)[:, 0], 1)             # [E]
+    hi = jnp.maximum((c * num_bins + off + nb - 2)[:, 0], 1)
+    return src, valid, recon, lo, hi
+
+
+def expand_bundle_hist(hist, pg, ph, pc, maps):
+    """[F_physical, B, 3] bundle histograms -> [E_logical, B, 3].
+
+    Each bundled feature's slots are gathered into its own bin range and its
+    default-bin entry is reconstructed as parent - sum(own slots)."""
+    src, valid, recon, lo, hi = maps
+    flat = hist.reshape(-1, hist.shape[-1])                     # [Fp*B, 3]
+    out = jnp.where(valid[:, :, None], flat[src], 0.0)
+    cs = jnp.cumsum(flat, axis=0)
+    range_sum = cs[hi] - cs[lo - 1]                             # [E, 3]
+    parent = jnp.stack([jnp.asarray(pg, flat.dtype),
+                        jnp.asarray(ph, flat.dtype),
+                        jnp.asarray(pc, flat.dtype)])
+    recon_val = parent[None, :] - range_sum
+    return jnp.where(recon[:, :, None], recon_val[:, None, :], out)
 
 
 def _set(arr, idx, value):
@@ -295,8 +357,11 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             dleft = splits.default_left[l]
 
             # --- decide row routing for leaf l (tree.h:257-313 semantics) ----
-            binf = lax.dynamic_index_in_dim(bins, feat, axis=1,
+            col_idx = feat if meta.col is None else meta.col[feat]
+            binf = lax.dynamic_index_in_dim(bins, col_idx, axis=1,
                                             keepdims=False).astype(jnp.int32)
+            if meta.col is not None:  # EFB: physical slot -> logical bin
+                binf = decode_bundle_bin(binf, feat, meta)
             mt_f = meta.missing_type[feat]
             nb_f = meta.num_bin[feat]
             db_f = meta.default_bin[feat]

@@ -33,7 +33,7 @@ def predict_binned_leaf(bins: jnp.ndarray,          # [N, F] int
                         default_left: jnp.ndarray,   # [P] bool
                         left_child: jnp.ndarray,     # [P] i32
                         right_child: jnp.ndarray,    # [P] i32
-                        feat_info: jnp.ndarray,      # [F, 3]: num_bin, missing, default_bin
+                        feat_info: jnp.ndarray,      # [E, 5]: num_bin, missing, default_bin, col, offset
                         is_cat: jnp.ndarray,         # [P] bool
                         cat_mask: jnp.ndarray        # [P, W] bool (W=1 if no cat)
                         ) -> jnp.ndarray:
@@ -55,10 +55,17 @@ def predict_binned_leaf(bins: jnp.ndarray,          # [N, F] int
         node, leaf = state
         nd = jnp.clip(node, 0, num_nodes - 1)
         f = split_feature[nd]
-        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        col = feat_info[f, 3]
+        b = jnp.take_along_axis(bins, col[:, None], axis=1)[:, 0].astype(jnp.int32)
         nb = feat_info[f, 0]
         mt = feat_info[f, 1]
         db = feat_info[f, 2]
+        # EFB decode: physical slot -> logical bin (data/bundling.py layout)
+        off = feat_info[f, 4]
+        local = b - off
+        in_range = (local >= 0) & (local < nb - 1)
+        sub = jnp.where(in_range, local + (local >= db).astype(jnp.int32), db)
+        b = jnp.where(off < 0, b, sub)
         is_missing = (((mt == MISSING_NAN) & (b == nb - 1))
                       | ((mt == MISSING_ZERO) & (b == db)))
         go_left = jnp.where(is_missing, default_left[nd], b <= threshold_bin[nd])
