@@ -169,26 +169,33 @@ class GBDT:
         mesh = make_mesh(cfg.mesh_devices or 0, axis)
         shards = int(mesh.devices.size)
         n = self.num_data
-        f = len(train.used_features)
         if cfg.tree_learner in ("data", "voting"):
             self._row_pad = pad_rows(n, shards)
             if self._row_pad:
                 self.bins = jnp.pad(self.bins, ((0, self._row_pad), (0, 0)))
         else:
-            self._feat_pad = pad_features(f, shards)
-            if self._feat_pad:
-                self.bins = jnp.pad(self.bins, ((0, 0), (0, self._feat_pad)))
-                pad1 = lambda a, v: jnp.pad(a, (0, self._feat_pad),
-                                            constant_values=v)
-                self.meta = FeatureMeta(
-                    num_bin=pad1(self.meta.num_bin, 1),
-                    missing_type=pad1(self.meta.missing_type, 0),
-                    default_bin=pad1(self.meta.default_bin, 0),
-                    is_categorical=pad1(self.meta.is_categorical, False))
+            bundled = self.meta.col is not None
+            ncols = int(self.bins.shape[1])
+            col_pad = pad_features(ncols, shards)
+            if col_pad:
+                # pad PHYSICAL columns; bundled logical meta stays intact
+                # (no logical feature maps to a pad column)
+                self.bins = jnp.pad(self.bins, ((0, 0), (0, col_pad)))
+            if not bundled:
+                self._feat_pad = col_pad
+                if col_pad:
+                    pad1 = lambda a, v: jnp.pad(a, (0, self._feat_pad),
+                                                constant_values=v)
+                    self.meta = FeatureMeta(
+                        num_bin=pad1(self.meta.num_bin, 1),
+                        missing_type=pad1(self.meta.missing_type, 0),
+                        default_bin=pad1(self.meta.default_bin, 0),
+                        is_categorical=pad1(self.meta.is_categorical, False))
         log.info("Using %s-parallel tree learner over %d devices",
                  cfg.tree_learner, shards)
         self.grow = make_distributed_grower(self.grower_cfg, mesh,
-                                            cfg.tree_learner, cfg.top_k)
+                                            cfg.tree_learner, cfg.top_k,
+                                            bundled=self.meta.col is not None)
 
     def _make_metrics(self, data: TrainingData) -> List[Metric]:
         out = []

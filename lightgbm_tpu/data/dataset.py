@@ -135,11 +135,11 @@ def construct(data: np.ndarray,
             log.fatal("Cannot construct Dataset: all features are trivial (constant)")
 
         # EFB: greedily bundle mutually-exclusive sparse features
-        # (FindGroups/FastFeatureBundling, dataset.cpp:66-210); the feature-
-        # and voting-parallel learners scan per-feature vote/slice sets, so
-        # bundling is enabled for the serial and data-parallel learners only
-        if (config.enable_bundle and len(ds.used_features) > 1
-                and config.tree_learner in ("serial", "data")):
+        # (FindGroups/FastFeatureBundling, dataset.cpp:66-210).  All tree
+        # learners consume bundles: serial/data expand physical histograms
+        # globally, feature-parallel expands its column window, voting
+        # expands locally before casting votes (parallel/learner.py)
+        if config.enable_bundle and len(ds.used_features) > 1:
             bs = sample[:min(len(sample), 20000)]
             nonzero = np.zeros((bs.shape[0], len(ds.used_features)), dtype=bool)
             for k, j in enumerate(ds.used_features):

@@ -175,15 +175,30 @@ class SerialStrategy:
         return x
 
 
-def make_expand_maps(meta: FeatureMeta, num_bins: int):
+def make_expand_maps(meta: FeatureMeta, num_bins: int,
+                     col_start=None, col_count: int = None):
     """Gather/reconstruction maps for expanding physical (bundle) histograms
     into per-logical-feature histograms (FixHistogram in tensor form,
-    dataset.cpp:749-768).  All entries are traced jnp ops over the meta."""
+    dataset.cpp:749-768).  All entries are traced jnp ops over the meta.
+
+    ``col_start``/``col_count`` restrict the maps to a contiguous physical
+    column window (feature-parallel shards own a column slice,
+    feature_parallel_tree_learner.cpp:31-50): sources are rebased to the
+    local flat layout and logical features outside the window are masked.
+    Returns ``(src, valid, recon, lo, hi, feat_in_window)`` where the last
+    entry is None for global maps."""
     b = jnp.arange(num_bins, dtype=jnp.int32)[None, :]          # [1, B]
     off = meta.offset[:, None]
     nb = meta.num_bin[:, None]
     db = meta.default_bin[:, None]
     c = meta.col[:, None]
+    if col_start is not None:
+        in_win = (c >= col_start) & (c < col_start + col_count)
+        c = c - col_start
+        flat_max = col_count * num_bins - 1
+    else:
+        in_win = None
+        flat_max = None
     slot = off + b - (b > db).astype(jnp.int32)
     src = jnp.where(off < 0, c * num_bins + b,
                     c * num_bins + jnp.clip(slot, 0, num_bins - 1))
@@ -191,7 +206,14 @@ def make_expand_maps(meta: FeatureMeta, num_bins: int):
     recon = (off >= 0) & (b == db) & valid
     lo = jnp.maximum((c * num_bins + off)[:, 0], 1)             # [E]
     hi = jnp.maximum((c * num_bins + off + nb - 2)[:, 0], 1)
-    return src, valid, recon, lo, hi
+    if in_win is not None:
+        valid = valid & in_win
+        recon = recon & in_win
+        src = jnp.clip(src, 0, flat_max)
+        lo = jnp.clip(lo, 1, flat_max)
+        hi = jnp.clip(hi, 1, flat_max)
+        return src, valid, recon, lo, hi, in_win[:, 0]
+    return src, valid, recon, lo, hi, None
 
 
 def expand_bundle_hist(hist, pg, ph, pc, maps):
@@ -199,7 +221,7 @@ def expand_bundle_hist(hist, pg, ph, pc, maps):
 
     Each bundled feature's slots are gathered into its own bin range and its
     default-bin entry is reconstructed as parent - sum(own slots)."""
-    src, valid, recon, lo, hi = maps
+    src, valid, recon, lo, hi = maps[:5]
     flat = hist.reshape(-1, hist.shape[-1])                     # [Fp*B, 3]
     out = jnp.where(valid[:, :, None], flat[src], 0.0)
     cs = jnp.cumsum(flat, axis=0)

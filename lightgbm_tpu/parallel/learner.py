@@ -34,7 +34,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from ..grower import (FeatureMeta, GrowerConfig, SerialStrategy, TreeArrays,
-                      make_grower)
+                      expand_bundle_hist, make_expand_maps, make_grower)
 from ..ops.split import SplitResult, best_split, per_feature_best_gain
 
 
@@ -89,8 +89,12 @@ class DataParallelStrategy(SerialStrategy):
 class FeatureParallelStrategy(SerialStrategy):
     """All rows on every device; features sliced per shard.
 
-    F must be padded to a multiple of the shard count (pad features are
-    masked via feat_valid=False).
+    The physical column count must be padded to a multiple of the shard
+    count (pad features are masked via feat_valid=False / absent from the
+    bundle maps).  With EFB bundles the shard owns a window of physical
+    columns and expands only the logical features living in that window
+    (``make_expand_maps`` with a column window); without bundles the
+    logical metadata is sliced directly.
     """
 
     def __init__(self, cfg: GrowerConfig, axis_name: str = "feature",
@@ -105,22 +109,42 @@ class FeatureParallelStrategy(SerialStrategy):
         ax = lax.axis_index(self.axis)
         start = ax * fl
         bins_local = lax.dynamic_slice(bins, (0, start), (n, fl))
-        meta_local = FeatureMeta(*[
-            lax.dynamic_slice(a, (start,), (fl,)) for a in meta])
+        if meta.col is not None:
+            # bundled: logical meta stays global; expansion maps are local
+            maps = make_expand_maps(meta, self.cfg.max_bin,
+                                    col_start=start, col_count=fl)
+            return (meta, feat_valid, bins_local, None, None, start, maps)
+        meta_local = FeatureMeta(
+            num_bin=lax.dynamic_slice(meta.num_bin, (start,), (fl,)),
+            missing_type=lax.dynamic_slice(meta.missing_type, (start,), (fl,)),
+            default_bin=lax.dynamic_slice(meta.default_bin, (start,), (fl,)),
+            is_categorical=lax.dynamic_slice(
+                meta.is_categorical, (start,), (fl,)))
         fv_local = lax.dynamic_slice(feat_valid, (start,), (fl,))
-        return (meta, feat_valid, bins_local, meta_local, fv_local, start)
+        return (meta, feat_valid, bins_local, meta_local, fv_local, start,
+                None)
 
     def hist_bins(self, ctx, bins):
         return ctx[2]
 
     def find(self, ctx, hist_child, pg, ph, pc):
-        _, _, _, meta_local, fv_local, start = ctx
-        # feature_base shifts to global numbering before the argmax sync
-        res = best_split(hist_child, pg, ph, pc, meta_local.num_bin,
-                         meta_local.missing_type, meta_local.default_bin,
-                         fv_local, self.cfg.split_config(),
-                         feature_base=start,
-                         is_cat=meta_local.is_categorical)
+        meta, feat_valid, _, meta_local, fv_local, start, maps = ctx
+        if maps is not None:
+            # expand the local physical histograms into the (global) logical
+            # feature space; features outside this shard's window are zeroed
+            # and masked, so the global numbering needs no feature_base shift
+            hist_log = expand_bundle_hist(hist_child, pg, ph, pc, maps)
+            res = best_split(hist_log, pg, ph, pc, meta.num_bin,
+                             meta.missing_type, meta.default_bin,
+                             feat_valid & maps[5], self.cfg.split_config(),
+                             is_cat=meta.is_categorical)
+        else:
+            # feature_base shifts to global numbering before the argmax sync
+            res = best_split(hist_child, pg, ph, pc, meta_local.num_bin,
+                             meta_local.missing_type, meta_local.default_bin,
+                             fv_local, self.cfg.split_config(),
+                             feature_base=start,
+                             is_cat=meta_local.is_categorical)
         return _broadcast_from_winner(res, self.axis)
 
 
@@ -147,8 +171,18 @@ class VotingStrategy(SerialStrategy):
     # the grower is therefore performed in each shard's local space.
 
     def find(self, ctx, hist_child, pg, ph, pc):
-        meta, feat_valid = ctx
+        meta, feat_valid, maps = ctx
         scfg = self.cfg.split_config()
+        if maps is not None:
+            # EFB: expand the LOCAL physical histograms with LOCAL parent
+            # sums (every row lands in exactly one bin of physical column 0,
+            # so its bin sums are the local leaf totals).  Expansion is
+            # linear in the histogram given additive parents, so the psum of
+            # locally-expanded slices below equals the expansion of the
+            # psum-reduced histogram.
+            pl = hist_child[0].sum(axis=0)                   # [3] local parent
+            hist_child = expand_bundle_hist(hist_child, pl[0], pl[1], pl[2],
+                                            maps)
         f = hist_child.shape[0]
         k = min(self.top_k, f)
         # local votes from local histograms with LOCAL parent sums (PV-tree
@@ -185,12 +219,14 @@ class VotingStrategy(SerialStrategy):
 
 def make_distributed_grower(cfg: GrowerConfig, mesh: Mesh,
                             tree_learner: str = "data",
-                            top_k: int = 20):
+                            top_k: int = 20, bundled: bool = False):
     """shard_map-wrapped grow function for a 1-D mesh.
 
     Returns ``fn(bins, gw, hw, cw, meta, feat_valid) -> (TreeArrays, row_leaf)``
     operating on global (host-level) arrays.  Rows (data/voting) or the
-    feature scan (feature) are sharded over the mesh axis.
+    feature scan (feature) are sharded over the mesh axis.  ``bundled``
+    states whether the FeatureMeta carries EFB col/offset arrays (their
+    specs must match the pytree).
     """
     axis = mesh.axis_names[0]
     n_shards = mesh.devices.size
@@ -211,7 +247,8 @@ def make_distributed_grower(cfg: GrowerConfig, mesh: Mesh,
 
     grow = make_grower(cfg, strategy)
     bins_spec = P(axis, None) if tree_learner in ("data", "voting") else P()
-    meta_spec = FeatureMeta(P(), P(), P(), P())
+    meta_spec = (FeatureMeta(P(), P(), P(), P(), P(), P()) if bundled
+                 else FeatureMeta(P(), P(), P(), P()))
     tree_spec = TreeArrays(*([P()] * len(TreeArrays._fields)))
 
     fn = shard_map(grow, mesh=mesh,

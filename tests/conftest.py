@@ -21,8 +21,49 @@ if os.environ.get("LGBM_TPU_TESTS_ON_TPU") != "1":
     import jax
     jax.config.update("jax_platforms", "cpu")
 
+import subprocess
+
 import numpy as np
 import pytest
+
+
+@pytest.fixture(scope="session")
+def ref_bin():
+    """Path to the reference LightGBM CLI — the interop oracle.
+
+    Resolution order: $LGBM_REF_BIN → cached build in <repo>/.refbuild →
+    cmake-build /root/reference on first use (reference tests/cpp_test
+    discipline: the reference binary validates our model files)."""
+    env = os.environ.get("LGBM_REF_BIN")
+    if env and os.access(env, os.X_OK):
+        return env
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    build_dir = os.path.join(root, ".refbuild")
+    binpath = os.path.join(build_dir, "lightgbm")
+    if os.access(binpath, os.X_OK):
+        return binpath
+    if not os.path.exists("/root/reference/CMakeLists.txt"):
+        pytest.skip("reference source not available")
+    os.makedirs(build_dir, exist_ok=True)
+    try:
+        subprocess.run(["cmake", "/root/reference", "-DCMAKE_BUILD_TYPE=Release"],
+                       cwd=build_dir, check=True, capture_output=True,
+                       timeout=300)
+        subprocess.run(["make", "-j2", "lightgbm"], cwd=build_dir, check=True,
+                       capture_output=True, timeout=1800)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            OSError) as e:
+        pytest.skip(f"reference CLI build failed: {e}")
+    finally:
+        # the reference CMakeLists SETs EXECUTABLE_OUTPUT_PATH to its own
+        # source dir (shadowing any -D override) — move the binary out so
+        # /root/reference stays pristine
+        stray = "/root/reference/lightgbm"
+        if os.path.exists(stray):
+            os.replace(stray, binpath)
+    if not os.access(binpath, os.X_OK):
+        pytest.skip("reference CLI build produced no binary")
+    return binpath
 
 
 @pytest.fixture(scope="session")

@@ -9,23 +9,30 @@ import lightgbm_tpu as lgb
 from lightgbm_tpu.data.bundling import BundleLayout, find_bundles
 
 
-def _one_hot_problem(n=4000, groups=3, cats=8, dense=2, seed=0):
+def _one_hot_problem(n=4000, groups=3, cats=8, dense=2, seed=0, n_valid=0):
     """`groups` blocks of `cats` mutually exclusive one-hot columns plus
-    `dense` dense numeric columns."""
+    `dense` dense numeric columns.
+
+    When ``n_valid`` > 0 the extra rows are drawn from the SAME
+    label-generating weights and returned as a held-out split (a valid set
+    from a different seed would have different weights — unlearnable)."""
     rng = np.random.RandomState(seed)
+    total = n + n_valid
     cols = []
-    logits = np.zeros(n)
+    logits = np.zeros(total)
     for g in range(groups):
-        which = rng.randint(0, cats, size=n)
-        block = np.zeros((n, cats))
-        block[np.arange(n), which] = rng.rand(n) + 0.5   # nonzero values
+        which = rng.randint(0, cats, size=total)
+        block = np.zeros((total, cats))
+        block[np.arange(total), which] = rng.rand(total) + 0.5  # nonzero values
         w = rng.randn(cats)
         logits += w[which]
         cols.append(block)
-    Xd = rng.randn(n, dense)
+    Xd = rng.randn(total, dense)
     logits += Xd @ rng.randn(dense)
     X = np.column_stack(cols + [Xd])
-    y = (logits + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    y = (logits + 0.3 * rng.randn(total) > 0).astype(np.float64)
+    if n_valid:
+        return X[:n], y[:n], X[n:], y[n:]
     return X, y
 
 
@@ -68,8 +75,7 @@ def _train(X, y, Xv, yv, enable_bundle):
 
 def test_bundled_training_matches_unbundled_exactly():
     """Zero conflicts -> identical split decisions, losses and predictions."""
-    X, y = _one_hot_problem()
-    Xv, yv = _one_hot_problem(n=1500, seed=1)
+    X, y, Xv, yv = _one_hot_problem(n_valid=1500)
     bst_b, ll_b = _train(X, y, Xv, yv, True)
     bst_u, ll_u = _train(X, y, Xv, yv, False)
     assert bst_b.inner.train_set.layout is not None
@@ -91,13 +97,12 @@ def test_bundled_training_matches_unbundled_exactly():
 def test_bundled_quality_with_conflicts():
     """Small conflict budget still trains to good quality."""
     rng = np.random.RandomState(5)
-    X, y = _one_hot_problem(seed=2)
+    X, y, Xv, yv = _one_hot_problem(seed=2, n_valid=1500)
     # inject 1% conflicts into the first block
     idx = rng.choice(len(X), size=len(X) // 100, replace=False)
     X = X.copy()
     X[idx, 0] = 1.0
     X[idx, 1] = 1.0
-    Xv, yv = _one_hot_problem(n=1500, seed=3)
     params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
               "min_data_in_leaf": 5, "max_conflict_rate": 0.02}
     d = lgb.Dataset(X, label=y)

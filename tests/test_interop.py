@@ -1,9 +1,11 @@
 """Model-file interoperability with the reference LightGBM CLI.
 
-The reference binary (built from /root/reference into /tmp/refbuild) is the
-oracle: models we save must load in `lightgbm task=predict` and produce the
-same predictions — including categorical bitset thresholds (the reference's
-own cpp_test discipline, tests/cpp_test/test.py)."""
+The reference binary (built on demand from /root/reference by the session
+fixture ``ref_bin`` in conftest.py) is the oracle: models we save must load
+in `lightgbm task=predict` and produce the same predictions — including
+categorical bitset thresholds (the reference's own cpp_test discipline,
+tests/cpp_test/test.py) — and models the reference trains must load and
+predict identically here."""
 import os
 import subprocess
 
@@ -13,29 +15,24 @@ import pytest
 import lightgbm_tpu as lgb
 from lightgbm_tpu.data.parser import load_text_file
 
-REF_BIN = os.environ.get("LGBM_REF_BIN", "/tmp/refbuild/lightgbm")
 CAT_DATA = "/root/reference/tests/data/categorical.data"
 
-needs_ref = pytest.mark.skipif(
-    not (os.path.exists(REF_BIN) and os.access(REF_BIN, os.X_OK)),
-    reason="reference lightgbm binary not available")
 
-
-def _ref_predict(model_path: str, data_path: str, tmp_path) -> np.ndarray:
+def _ref_predict(ref_bin: str, model_path: str, data_path: str,
+                 tmp_path) -> np.ndarray:
     out = str(tmp_path / "ref_preds.txt")
     conf = str(tmp_path / "pred.conf")
     with open(conf, "w") as f:
         f.write(f"task=predict\ndata={data_path}\n"
                 f"input_model={model_path}\noutput_result={out}\n")
-    subprocess.run([REF_BIN, f"config={conf}"], check=True,
+    subprocess.run([ref_bin, f"config={conf}"], check=True,
                    capture_output=True, timeout=120)
     return np.loadtxt(out)
 
 
-@needs_ref
 @pytest.mark.skipif(not os.path.exists(CAT_DATA),
                     reason="reference categorical.data missing")
-def test_categorical_model_predict_parity(tmp_path):
+def test_categorical_model_predict_parity(ref_bin, tmp_path):
     X, y, _ = load_text_file(CAT_DATA, label_idx=0)
     cat_cols = [0, 1, 2, 4, 5, 6]
     params = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 20,
@@ -46,13 +43,12 @@ def test_categorical_model_predict_parity(tmp_path):
         "expected categorical splits in the model"
     model_path = str(tmp_path / "model.txt")
     bst.save_model(model_path)
-    ref = _ref_predict(model_path, CAT_DATA, tmp_path)
+    ref = _ref_predict(ref_bin, model_path, CAT_DATA, tmp_path)
     ours = bst.predict(X)
     np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
 
 
-@needs_ref
-def test_numerical_model_predict_parity(tmp_path):
+def test_numerical_model_predict_parity(ref_bin, tmp_path):
     train_path = "/root/reference/examples/binary_classification/binary.train"
     if not os.path.exists(train_path):
         pytest.skip("reference example data missing")
@@ -61,13 +57,12 @@ def test_numerical_model_predict_parity(tmp_path):
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
     model_path = str(tmp_path / "model.txt")
     bst.save_model(model_path)
-    ref = _ref_predict(model_path, train_path, tmp_path)
+    ref = _ref_predict(ref_bin, model_path, train_path, tmp_path)
     ours = bst.predict(X)
     np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
 
 
-@needs_ref
-def test_load_reference_trained_model(tmp_path):
+def test_load_reference_trained_model(ref_bin, tmp_path):
     """Models trained BY the reference CLI must load and predict identically
     in our framework (the reverse direction)."""
     train_path = "/root/reference/examples/binary_classification/binary.train"
@@ -79,10 +74,10 @@ def test_load_reference_trained_model(tmp_path):
         f.write(f"task=train\nobjective=binary\ndata={train_path}\n"
                 f"num_trees=10\nnum_leaves=31\noutput_model={model_path}\n"
                 f"verbosity=-1\n")
-    subprocess.run([REF_BIN, f"config={conf}"], check=True,
+    subprocess.run([ref_bin, f"config={conf}"], check=True,
                    capture_output=True, timeout=300)
     X, y, _ = load_text_file(train_path, label_idx=0)
     bst = lgb.Booster(model_file=model_path)
     ours = bst.predict(X)
-    ref = _ref_predict(model_path, train_path, tmp_path)
+    ref = _ref_predict(ref_bin, model_path, train_path, tmp_path)
     np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)

@@ -65,6 +65,45 @@ def test_voting_parallel_quality(data):
     assert auc_vote == pytest.approx(auc_serial, abs=2e-2)
 
 
+def _bundled_problem(n=3000, groups=3, cats=6, dense=2, n_valid=1000, seed=7):
+    """One-hot blocks that EFB bundles + dense columns; valid split drawn
+    from the same label weights."""
+    rng = np.random.RandomState(seed)
+    total = n + n_valid
+    cols = []
+    logits = np.zeros(total)
+    for g in range(groups):
+        which = rng.randint(0, cats, size=total)
+        block = np.zeros((total, cats))
+        block[np.arange(total), which] = rng.rand(total) + 0.5
+        logits += rng.randn(cats)[which]
+        cols.append(block)
+    Xd = rng.randn(total, dense)
+    logits += Xd @ rng.randn(dense)
+    X = np.column_stack(cols + [Xd])
+    y = (logits + 0.3 * rng.randn(total) > 0).astype(np.float64)
+    return X[:n], y[:n], X[n:], y[n:]
+
+
+@pytest.mark.parametrize("learner", ["feature", "data", "voting"])
+def test_parallel_learners_with_bundles(learner):
+    """EFB bundles flow through every distributed strategy (the round-1
+    regression: bundled FeatureMeta crashed feature/voting learners)."""
+    X, y, Xt, yt = _bundled_problem()
+    auc_serial, bst_s = _train_auc(X, y, Xt, yt, {"tree_learner": "serial"})
+    extra = {"tree_learner": learner}
+    if learner == "voting":
+        extra["top_k"] = 8
+    auc_p, bst_p = _train_auc(X, y, Xt, yt, extra)
+    assert bst_p.inner.train_set.layout is not None, "expected EFB bundles"
+    tol = 2e-2 if learner == "voting" else 5e-3
+    assert auc_p == pytest.approx(auc_serial, abs=tol)
+    if learner == "feature":
+        t_s, t_p = bst_s.inner.models[0], bst_p.inner.models[0]
+        np.testing.assert_array_equal(t_s.split_feature, t_p.split_feature)
+        np.testing.assert_array_equal(t_s.threshold_bin, t_p.threshold_bin)
+
+
 def test_multiclass_data_parallel():
     rng = np.random.RandomState(3)
     n, k = 2000, 3
