@@ -6,13 +6,25 @@ histograms with hand-rolled atomic float adds): instead of scatter-adds,
 each grid step builds a one-hot of the combined (feature, bin) index for a
 row tile *in VMEM* and contracts it against the per-row weight channels on
 the MXU.  The [rows, features*bins] one-hot never exists in HBM — only the
-[feature_tile, B, 6] accumulator block does, revisited across row tiles.
+[feature_tile * B] accumulator block does, revisited across row tiles.
 
 Layout: bins come in transposed ``[F, N]`` so the row dimension is the lane
 axis of each block.  Weights ``w_t [6, N]`` carry the bf16 channels
 ``(g_hi, g_lo, h_hi, h_lo, c, 0)`` — gradients/hessians are hi/lo-split so a
 single-pass bf16 MXU dot accumulates with ~f32 accuracy (recombined by the
 caller, ``subset_histogram_pallas``).
+
+Mosaic constraints shape two choices here (round-2 lesson: the kernel failed
+`infer-vector-layout: unsupported shape cast` on a `vector<512x8x255xi1>`
+reshape):
+
+* the per-bin axis is padded up to a multiple of the 128-wide lane register
+  (255 -> 256) so every reshape keeps the lane dimension aligned; the caller
+  slices the phantom bins off (they are provably zero: bin ids < num_bins);
+* the boolean one-hot is cast to the matmul dtype *before* the
+  [TR, TF, B] -> [TR, TF*B] collapse, so Mosaic never has to lay out an i1
+  vector across a shape cast — and the kernel's output block stays 2D
+  ([6, TF*B]); the reshape to [6, F, B] happens outside Pallas in XLA.
 """
 from __future__ import annotations
 
@@ -22,9 +34,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-NUM_CH = 6  # weight channels: (g_hi, g_lo, h_hi, h_lo, c, unused)
+NUM_CH = 6   # weight channels: (g_hi, g_lo, h_hi, h_lo, c, unused)
+LANES = 128  # TPU vector register lane width — bin axis is padded to this
 
 
 def _hist_kernel(bins_ref, w_ref, out_ref, *, num_bins: int, feat_tile: int):
@@ -39,14 +51,16 @@ def _hist_kernel(bins_ref, w_ref, out_ref, *, num_bins: int, feat_tile: int):
     tr = bins.shape[1]
     # one-hot of the bin index per (row, feature-in-tile): [TR, TF, B];
     # flattened over (feature, bin) it is the combined-index one-hot.
+    # num_bins is lane-aligned and the cast precedes the collapse (see
+    # module docstring for the Mosaic rationale).
     onehot = (bins.T[:, :, None] ==
-              lax.broadcasted_iota(jnp.int32, (tr, feat_tile, num_bins), 2))
-    onehot2d = onehot.reshape(tr, feat_tile * num_bins).astype(w.dtype)
+              lax.broadcasted_iota(jnp.int32, (tr, feat_tile, num_bins), 2)
+              ).astype(w.dtype)
+    onehot2d = onehot.reshape(tr, feat_tile * num_bins)
     # channels on the SUBLANE axis: [6, TR] @ [TR, TF*B] pads 6 -> 8 rows
     # instead of 6 -> 128 lanes (16x less MXU waste than the transposed form)
-    part = jnp.dot(w, onehot2d,
-                   preferred_element_type=jnp.float32)  # [6, TF*B]
-    out_ref[...] += part.reshape(NUM_CH, feat_tile, num_bins)
+    out_ref[...] += jnp.dot(w, onehot2d,
+                            preferred_element_type=jnp.float32)  # [6, TF*B]
 
 
 def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
@@ -59,20 +73,23 @@ def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
     """
     f, n = bins_t.shape
     assert f % feat_tile == 0 and n % row_tile == 0, (f, n, feat_tile, row_tile)
+    b_pad = -(-num_bins // LANES) * LANES
     grid = (f // feat_tile, n // row_tile)
-    return pl.pallas_call(
-        functools.partial(_hist_kernel, num_bins=num_bins,
+    out2d = pl.pallas_call(
+        functools.partial(_hist_kernel, num_bins=b_pad,
                           feat_tile=feat_tile),
         grid=grid,
         in_specs=[
             pl.BlockSpec((feat_tile, row_tile), lambda fi, ri: (fi, ri)),
             pl.BlockSpec((NUM_CH, row_tile), lambda fi, ri: (0, ri)),
         ],
-        out_specs=pl.BlockSpec((NUM_CH, feat_tile, num_bins),
-                               lambda fi, ri: (0, fi, 0)),
-        out_shape=jax.ShapeDtypeStruct((NUM_CH, f, num_bins), jnp.float32),
+        out_specs=pl.BlockSpec((NUM_CH, feat_tile * b_pad),
+                               lambda fi, ri: (0, fi)),
+        out_shape=jax.ShapeDtypeStruct((NUM_CH, f * b_pad), jnp.float32),
         interpret=interpret,
     )(bins_t, w_t)
+    # un-flatten and drop the lane-padding bins outside the kernel (plain XLA)
+    return out2d.reshape(NUM_CH, f, b_pad)[:, :, :num_bins]
 
 
 def subset_histogram_pallas(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
