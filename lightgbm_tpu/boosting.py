@@ -29,10 +29,10 @@ from .data.dataset import TrainingData
 from .grower import FeatureMeta, GrowerConfig, make_grower
 from .metrics import Metric, create_metric, default_metric_for_objective
 from .objectives import Objective, create_objective, parse_objective_string
-from .predictor import Predictor, tree_scores_binned
+from .predictor import Predictor, predict_binned_leaf, tree_scores_binned
 from .tree import Tree
 from .utils import log
-from .utils.random import make_rng
+from .utils.random import make_rng, sample_k
 from .utils.timer import PhaseTimers
 
 
@@ -134,6 +134,7 @@ class GBDT:
         self._feat_valid_base = np.ones(len(fm["is_categorical"]), dtype=bool)
         self._bag_weight = jnp.ones((n,), jnp.float32)
         self._bag_cnt = jnp.ones((n,), jnp.float32)
+        self._subset_state = None     # (bins[M,F], idx[M], w[M], cnt[M])
         self._bag_rng = make_rng(cfg.bagging_seed)
         self._feat_rng = make_rng(cfg.feature_fraction_seed)
 
@@ -155,6 +156,9 @@ class GBDT:
         n_devices = len(jax.devices())
         use_dist = cfg.tree_learner != "serial" and (
             cfg.mesh_devices != 1 and n_devices > 1)
+        # the bagged-subset optimization (gbdt.cpp:323-382 is_use_subset_)
+        # gathers rows into a compact matrix — serial learner only for now
+        self._can_subset = not use_dist
         if not use_dist:
             if cfg.tree_learner != "serial":
                 log.warning("tree_learner=%s requested but only one device is "
@@ -234,14 +238,57 @@ class GBDT:
         log.info("Start training from score %f", init)
 
     def _bagging(self, it: int, grad, hess) -> None:
-        """Bernoulli row bagging (gbdt.cpp:323-382 semantics, vectorized)."""
+        """Row bagging (gbdt.cpp:323-382).
+
+        fraction <= 0.5 (the reference's ``is_use_subset_`` regime): exact
+        ``fraction * N`` rows sampled without replacement are GATHERED into a
+        compact device matrix and the tree grows on that — per-tree cost is
+        O(bagged rows), not O(N).  Larger fractions keep the cheaper 0/1
+        weight-mask form (Bernoulli, vectorized)."""
         cfg = self.config
         if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
             if it % cfg.bagging_freq == 0:
-                mask = (self._bag_rng.random(self.num_data)
-                        < cfg.bagging_fraction).astype(np.float32)
-                self._bag_weight = jnp.asarray(mask)
-                self._bag_cnt = self._bag_weight
+                n = self.num_data
+                if self._can_subset and cfg.bagging_fraction <= 0.5:
+                    m = max(1, int(n * cfg.bagging_fraction))
+                    idx = sample_k(self._bag_rng, n, m)
+                    self._set_subset(idx, np.ones(m, np.float32))
+                else:
+                    self._subset_state = None
+                    mask = (self._bag_rng.random(n)
+                            < cfg.bagging_fraction).astype(np.float32)
+                    self._bag_weight = jnp.asarray(mask)
+                    self._bag_cnt = self._bag_weight
+                self._bagging_on = True
+        elif getattr(self, "_bagging_on", False):
+            # bagging turned off mid-training (reset_parameter callback,
+            # ResetBaggingConfig analogue): drop the stale subset/mask so
+            # trees see the full data again
+            self._bagging_on = False
+            self._subset_state = None
+            self._bag_weight = jnp.ones((self.num_data,), jnp.float32)
+            self._bag_cnt = self._bag_weight
+
+    def _set_subset(self, idx: np.ndarray, w: np.ndarray) -> None:
+        """Gather rows ``idx`` (weights ``w``) into the compact subset matrix.
+
+        Padded to a power-of-two bucket so re-bagging recompiles the grower at
+        most log2 times; padding rows point at row 0 with weight 0 (they flow
+        through the partition but contribute nothing to any histogram,
+        count, or output)."""
+        m = len(idx)
+        m_pad = max(1 << max(int(m - 1).bit_length(), 0), 1024)
+        pad = m_pad - m
+        idx_p = np.concatenate([idx.astype(np.int32),
+                                np.zeros(pad, np.int32)])
+        w_p = np.concatenate([w.astype(np.float32), np.zeros(pad, np.float32)])
+        idx_d = jnp.asarray(idx_p)
+        self._subset_state = (jnp.take(self.bins, idx_d, axis=0),
+                              idx_d,
+                              jnp.asarray(w_p),
+                              jnp.asarray((w_p > 0).astype(np.float32)))
+        self._bag_weight = jnp.ones((self.num_data,), jnp.float32)
+        self._bag_cnt = self._bag_weight
 
     def _feature_sample(self) -> np.ndarray:
         frac = self.config.feature_fraction
@@ -284,23 +331,33 @@ class GBDT:
 
         lr = self._shrinkage_rate()
         any_split = False
-        feat_mask = np.asarray(self._feature_sample())
-        if self._feat_pad:
-            feat_mask = np.concatenate(
-                [feat_mask, np.zeros(self._feat_pad, dtype=bool)])
-        feat_mask = jnp.asarray(feat_mask)
 
         def padded(x):
             return jnp.pad(x, (0, self._row_pad)) if self._row_pad else x
 
         for k in range(self.num_class):
+            # re-sampled PER TREE like the reference's BeforeTrain
+            # (serial_tree_learner.cpp:234-260), not once per iteration
+            feat_mask = np.asarray(self._feature_sample())
+            if self._feat_pad:
+                feat_mask = np.concatenate(
+                    [feat_mask, np.zeros(self._feat_pad, dtype=bool)])
+            feat_mask = jnp.asarray(feat_mask)
             with self.timers.phase("tree"):
-                arrays, row_leaf = self.grow(self.bins,
-                                             padded(g[k] * self._bag_weight),
-                                             padded(h[k] * self._bag_weight),
-                                             padded(cnt), self.meta, feat_mask)
-                if self._row_pad:
-                    row_leaf = row_leaf[:self.num_data]
+                if self._subset_state is not None:
+                    # compact bagged matrix: tree cost is O(bagged rows)
+                    sbins, sidx, sw, scnt = self._subset_state
+                    arrays, row_leaf = self.grow(sbins, g[k][sidx] * sw,
+                                                 h[k][sidx] * sw, scnt,
+                                                 self.meta, feat_mask)
+                else:
+                    arrays, row_leaf = self.grow(self.bins,
+                                                 padded(g[k] * self._bag_weight),
+                                                 padded(h[k] * self._bag_weight),
+                                                 padded(cnt), self.meta,
+                                                 feat_mask)
+                    if self._row_pad:
+                        row_leaf = row_leaf[:self.num_data]
                 num_leaves = int(arrays.num_leaves)
                 tree = Tree.from_arrays(arrays, self.train_set.used_features,
                                         self.train_set.bin_mappers,
@@ -310,13 +367,31 @@ class GBDT:
             if num_leaves > 1:
                 any_split = True
                 with self.timers.phase("score"):
+                    if self._subset_state is not None:
+                        # out-of-bag rows need scores too (UpdateScoreOutOfBag,
+                        # gbdt.cpp:452-463): route ALL rows through the fresh
+                        # device-side tree — no host round-trip
+                        row_leaf = predict_binned_leaf(
+                            self.bins, arrays.split_feature,
+                            arrays.threshold_bin, arrays.default_left,
+                            arrays.left_child, arrays.right_child,
+                            self.feat_info, arrays.is_cat, arrays.cat_bins)
                     self.scores = self.scores.at[k].set(self._update_score(
                         self.scores[k], arrays.leaf_value, row_leaf,
                         jnp.asarray(lr, jnp.float32)))
+                    # valid sets are scored from the DEVICE-side TreeArrays —
+                    # no host tree conversion or per-tree jit re-entry in the
+                    # hot loop (weak-spot fix: tree_scores_binned stays for
+                    # replay/rollback/DART paths only)
                     for vs in self.valid_sets:
-                        vs.scores = vs.scores.at[k].add(tree_scores_binned(
-                            vs.bins, tree, self.used_feature_index,
-                            self.feat_info, self.train_set.bin_mappers))
+                        vleaf = predict_binned_leaf(
+                            vs.bins, arrays.split_feature,
+                            arrays.threshold_bin, arrays.default_left,
+                            arrays.left_child, arrays.right_child,
+                            self.feat_info, arrays.is_cat, arrays.cat_bins)
+                        vs.scores = vs.scores.at[k].set(self._update_score(
+                            vs.scores[k], arrays.leaf_value, vleaf,
+                            jnp.asarray(lr, jnp.float32)))
                     jax.block_until_ready(self.scores)
         self._after_iter()
         self.iter_ += 1
@@ -640,6 +715,7 @@ class GOSS(GBDT):
         if it < int(1.0 / max(cfg.learning_rate, 1e-10)):
             ones = jnp.ones((n,), jnp.float32)
             self._bag_weight = ones
+            self._subset_state = None
             return g, h, ones
         s = np.asarray(jnp.sum(jnp.abs(g * h), axis=0))
         top_k = max(1, int(n * cfg.top_rate))
@@ -651,6 +727,13 @@ class GOSS(GBDT):
         keep_prob = min(1.0, other_k / max(rest, 1))
         keep_other = (~is_top) & (self._bag_rng.random(n) < keep_prob)
         multiply = (n - top_k) / other_k
+        if self._can_subset and cfg.top_rate + cfg.other_rate <= 0.5:
+            # goss.hpp:120-130 subset regime: gather kept rows, grow compact
+            idx = np.flatnonzero(is_top | keep_other)
+            w = np.where(is_top[idx], 1.0, multiply).astype(np.float32)
+            self._set_subset(idx.astype(np.int32), w)
+            return g, h, self._bag_cnt
+        self._subset_state = None
         w = np.where(is_top, 1.0, np.where(keep_other, multiply, 0.0)) \
             .astype(np.float32)
         cnt = (w > 0).astype(np.float32)

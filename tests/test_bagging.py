@@ -1,0 +1,156 @@
+"""Bagged-subset training path (gbdt.cpp:323-382 ``is_use_subset_``,
+goss.hpp:120-130): when the sampled fraction is <= 0.5 the rows are gathered
+into a compact device matrix and the tree grows on O(bagged rows); scores of
+out-of-bag rows are updated by routing ALL rows through the fresh tree
+(UpdateScoreOutOfBag, gbdt.cpp:452-463)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.boosting import GOSS, create_boosting
+from lightgbm_tpu.config import config_from_params
+from lightgbm_tpu.data.dataset import construct
+from lightgbm_tpu.objectives import create_objective
+
+
+def _make_problem(n=4000, f=10, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f)
+    y = ((X @ w + 0.5 * rng.randn(n)) > 0).astype(np.float32)
+    return X, y
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(len(p))
+    pos = y > 0
+    n1, n0 = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n1 * (n1 - 1) / 2) / (n1 * n0)
+
+
+def test_grow_subset_matches_masked_full():
+    """Growing on a gathered compact subset must find the same tree as
+    growing on the full matrix with a 0/1 weight mask (same weighted
+    histograms by construction)."""
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig, make_grower
+    import jax
+
+    rng = np.random.RandomState(0)
+    n, f, b = 2000, 6, 32
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = np.abs(rng.randn(n)).astype(np.float32) + 0.1
+    mask = (rng.rand(n) < 0.4).astype(np.float32)
+    idx = np.flatnonzero(mask > 0).astype(np.int32)
+    m_pad = 1 << int(len(idx) - 1).bit_length()
+    idx_p = np.concatenate([idx, np.zeros(m_pad - len(idx), np.int32)])
+    w_p = np.concatenate([np.ones(len(idx), np.float32),
+                          np.zeros(m_pad - len(idx), np.float32)])
+
+    cfg = GrowerConfig(num_leaves=15, min_data_in_leaf=5,
+                       min_sum_hessian_in_leaf=1e-3, max_bin=b,
+                       hist_method="einsum", bucket_min_log2=6)
+    meta = FeatureMeta(
+        num_bin=jnp.full((f,), b, jnp.int32),
+        missing_type=jnp.zeros((f,), jnp.int32),
+        default_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool))
+    fv = jnp.ones((f,), bool)
+    grow = jax.jit(make_grower(cfg))
+
+    full, _ = grow(jnp.asarray(bins), jnp.asarray(g * mask),
+                   jnp.asarray(h * mask), jnp.asarray(mask), meta, fv)
+    sub, _ = grow(jnp.asarray(bins[idx_p]), jnp.asarray(g[idx_p] * w_p),
+                  jnp.asarray(h[idx_p] * w_p), jnp.asarray(w_p), meta, fv)
+    nl = int(full.num_leaves)
+    assert nl == int(sub.num_leaves) and nl > 2
+    np.testing.assert_array_equal(np.asarray(full.split_feature[:nl - 1]),
+                                  np.asarray(sub.split_feature[:nl - 1]))
+    np.testing.assert_array_equal(np.asarray(full.threshold_bin[:nl - 1]),
+                                  np.asarray(sub.threshold_bin[:nl - 1]))
+    np.testing.assert_allclose(np.asarray(full.leaf_value[:nl]),
+                               np.asarray(sub.leaf_value[:nl]),
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_bagging_subset_trains_and_scores_all_rows():
+    X, y = _make_problem()
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 10,
+              "learning_rate": 0.15, "verbose": -1,
+              "bagging_fraction": 0.25, "bagging_freq": 1}
+    cfg = config_from_params(params)
+    ds = construct(X, cfg, label=y)
+    bst = create_boosting(cfg, ds, create_objective(cfg))
+    for _ in range(15):
+        bst.train_one_iter()
+    assert bst._subset_state is not None
+    sbins = bst._subset_state[0]
+    assert sbins.shape[0] == 1024  # 1000 bagged rows -> pow2 bucket
+    # out-of-bag rows got score updates too (UpdateScoreOutOfBag): after 15
+    # bagged iterations virtually no row can still sit at the constant
+    # boost-from-average init score
+    scores = np.asarray(bst.scores[0])
+    init = float(np.log(y.mean() / (1 - y.mean())))
+    stuck = np.isclose(scores, init, atol=1e-9).mean()
+    assert stuck < 0.01, stuck
+    auc = _auc(y, scores)
+    assert auc > 0.8, auc
+
+
+def test_goss_subset_matches_mask_path():
+    X, y = _make_problem(n=3000)
+    params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
+              "min_data_in_leaf": 10, "learning_rate": 0.2, "verbose": -1,
+              "top_rate": 0.2, "other_rate": 0.1}
+    preds = []
+    for force_mask in (False, True):
+        cfg = config_from_params(params)
+        ds = construct(X, cfg, label=y)
+        bst = create_boosting(cfg, ds, create_objective(cfg))
+        assert isinstance(bst, GOSS)
+        if force_mask:
+            bst._can_subset = False
+        for _ in range(10):
+            bst.train_one_iter()
+        assert (bst._subset_state is None) == force_mask
+        preds.append(np.asarray(bst.predict(X[:300])))
+    np.testing.assert_allclose(preds[0], preds[1], rtol=5e-3, atol=5e-4)
+
+
+def test_rf_with_subset_bagging():
+    X, y = _make_problem(n=2500)
+    params = {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+              "min_data_in_leaf": 10, "verbose": -1,
+              "bagging_fraction": 0.4, "bagging_freq": 1,
+              "feature_fraction": 0.8}
+    cfg = config_from_params(params)
+    ds = construct(X, cfg, label=y)
+    bst = create_boosting(cfg, ds, create_objective(cfg))
+    for _ in range(8):
+        bst.train_one_iter()
+    assert bst._subset_state is not None
+    auc = _auc(y, np.asarray(bst.predict(X)))
+    assert auc > 0.75, auc
+
+
+def test_bagging_switch_off_mid_training_clears_subset():
+    """ResetBaggingConfig analogue: disabling bagging mid-training must drop
+    the stale subset so later trees grow on the full data."""
+    X, y = _make_problem(n=2000)
+    params = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 10,
+              "learning_rate": 0.2, "verbose": -1,
+              "bagging_fraction": 0.3, "bagging_freq": 1}
+    cfg = config_from_params(params)
+    ds = construct(X, cfg, label=y)
+    bst = create_boosting(cfg, ds, create_objective(cfg))
+    for _ in range(3):
+        bst.train_one_iter()
+    assert bst._subset_state is not None
+    bst.config.bagging_freq = 0           # reset_parameter-style live change
+    bst.train_one_iter()
+    assert bst._subset_state is None
+    root_count = bst.models[-1].internal_count[0]
+    assert root_count == pytest.approx(len(X))   # full data again
