@@ -84,7 +84,9 @@ class GBDT:
 
     def _setup_device(self, train: TrainingData) -> None:
         cfg = self.config
-        self.bins = jnp.asarray(train.binned)
+        # host-side for now; _setup_grower owns device placement (multi-
+        # process mode shards this globally instead of uploading it whole)
+        self.bins = train.binned
         fm = train.feature_meta()
         bundled = "col" in fm
         self.meta = FeatureMeta(
@@ -150,12 +152,26 @@ class GBDT:
 
     def _setup_grower(self, cfg: Config, train: TrainingData) -> None:
         """Select the tree learner (CreateTreeLearner analogue):
-        serial on one device; data/feature/voting over the device mesh."""
+        serial on one device; data/feature/voting over the device mesh.
+
+        Multi-process (multi-host) mode: each process holds its OWN row
+        partition (the reference's pre-partitioned parallel learning,
+        ``docs/Parallel-Learning-Guide.md``); the binned matrix becomes one
+        global jax.Array row-sharded across all processes' devices, and
+        per-tree gradient vectors are assembled the same way."""
         self._row_pad = 0
         self._feat_pad = 0
+        self._multiproc = False
+        self._local_bins_cache = None
         n_devices = len(jax.devices())
         use_dist = cfg.tree_learner != "serial" and (
             cfg.mesh_devices != 1 and n_devices > 1)
+        from .parallel.sync import process_count
+        if process_count() > 1 and not use_dist:
+            log.fatal("num_machines > 1 requires tree_learner=data or voting "
+                      "over >1 devices (each process holds a row partition; "
+                      "a serial learner would silently train per-partition "
+                      "models)")
         # the bagged-subset optimization (gbdt.cpp:323-382 is_use_subset_)
         # gathers rows into a compact matrix — serial learner only for now
         self._can_subset = not use_dist
@@ -165,6 +181,7 @@ class GBDT:
                             "in use (devices=%d, mesh_devices=%d); falling "
                             "back to serial", cfg.tree_learner, n_devices,
                             cfg.mesh_devices)
+            self.bins = jnp.asarray(self.bins)
             self.grow = jax.jit(make_grower(self.grower_cfg))
             return
         from .parallel.learner import make_distributed_grower
@@ -173,18 +190,48 @@ class GBDT:
         mesh = make_mesh(cfg.mesh_devices or 0, axis)
         shards = int(mesh.devices.size)
         n = self.num_data
-        if cfg.tree_learner in ("data", "voting"):
-            self._row_pad = pad_rows(n, shards)
+        self._multiproc = jax.process_count() > 1
+        if self._multiproc and cfg.tree_learner not in ("data", "voting"):
+            log.fatal("multi-process training supports tree_learner=data or "
+                      "voting (feature-parallel shards columns, which does "
+                      "not match per-machine row partitions)")
+        if self._multiproc:
+            from jax.experimental import multihost_utils
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            # every process contributes its local partition; per-device row
+            # count must agree globally, so pad to the global max
+            local_devs = jax.local_device_count()
+            counts = np.asarray(multihost_utils.process_allgather(
+                np.asarray([n]))).reshape(-1)
+            per_dev = int(-(-int(counts.max()) // local_devs))
+            self._row_pad = per_dev * local_devs - n
+            self._global_rows = per_dev * shards
+            binned = np.asarray(train.binned)
             if self._row_pad:
-                self.bins = jnp.pad(self.bins, ((0, self._row_pad), (0, 0)))
+                binned = np.pad(binned, ((0, self._row_pad), (0, 0)))
+            self._row_sharding = NamedSharding(mesh, P(axis))
+            self.bins = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P(axis, None)), binned,
+                (self._global_rows, binned.shape[1]))
+            # replicated inputs go in as host arrays (jit replicates them);
+            # device-committed single-process arrays would be rejected
+            self.meta = FeatureMeta(*[None if f is None else np.asarray(f)
+                                      for f in self.meta])
+            log.info("Multi-process training: %d processes, %d local rows, "
+                     "%d global (padded) rows", jax.process_count(), n,
+                     self._global_rows)
+        elif cfg.tree_learner in ("data", "voting"):
+            self._row_pad = pad_rows(n, shards)
+            self.bins = (jnp.pad(self.bins, ((0, self._row_pad), (0, 0)))
+                         if self._row_pad else jnp.asarray(self.bins))
         else:
             bundled = self.meta.col is not None
             ncols = int(self.bins.shape[1])
             col_pad = pad_features(ncols, shards)
-            if col_pad:
-                # pad PHYSICAL columns; bundled logical meta stays intact
-                # (no logical feature maps to a pad column)
-                self.bins = jnp.pad(self.bins, ((0, 0), (0, col_pad)))
+            # pad PHYSICAL columns; bundled logical meta stays intact
+            # (no logical feature maps to a pad column)
+            self.bins = (jnp.pad(self.bins, ((0, 0), (0, col_pad)))
+                         if col_pad else jnp.asarray(self.bins))
             if not bundled:
                 self._feat_pad = col_pad
                 if col_pad:
@@ -224,10 +271,18 @@ class GBDT:
     # --------------------------------------------------------------- training
 
     def _boost_from_average(self) -> None:
-        """gbdt.cpp:407-480: constant init tree from the label average."""
-        init = self.objective.custom_average()
-        if init is None:
-            init = float(np.asarray(self.objective.labels).mean())
+        """gbdt.cpp:407-480: constant init tree from the label average.
+
+        Multi-process: the average is computed from globally summed
+        (numerator, denominator) stats before the objective's transform —
+        GlobalSyncUpByMean — so every rank starts from the same score."""
+        num, den = self.objective.average_stats()
+        if self._multiproc:
+            from .parallel.sync import allgather_object
+            parts = allgather_object((num, den))
+            num = sum(p[0] for p in parts)
+            den = sum(p[1] for p in parts)
+        init = self.objective.init_from_average(num / max(den, 1e-300))
         tree = Tree(1)
         tree.leaf_value[0] = init
         self.models.append(tree)
@@ -331,10 +386,6 @@ class GBDT:
 
         lr = self._shrinkage_rate()
         any_split = False
-
-        def padded(x):
-            return jnp.pad(x, (0, self._row_pad)) if self._row_pad else x
-
         for k in range(self.num_class):
             # re-sampled PER TREE like the reference's BeforeTrain
             # (serial_tree_learner.cpp:234-260), not once per iteration
@@ -342,7 +393,8 @@ class GBDT:
             if self._feat_pad:
                 feat_mask = np.concatenate(
                     [feat_mask, np.zeros(self._feat_pad, dtype=bool)])
-            feat_mask = jnp.asarray(feat_mask)
+            if not self._multiproc:   # multiproc: host arrays auto-replicate
+                feat_mask = jnp.asarray(feat_mask)
             with self.timers.phase("tree"):
                 if self._subset_state is not None:
                     # compact bagged matrix: tree cost is O(bagged rows)
@@ -351,13 +403,16 @@ class GBDT:
                                                  h[k][sidx] * sw, scnt,
                                                  self.meta, feat_mask)
                 else:
-                    arrays, row_leaf = self.grow(self.bins,
-                                                 padded(g[k] * self._bag_weight),
-                                                 padded(h[k] * self._bag_weight),
-                                                 padded(cnt), self.meta,
-                                                 feat_mask)
-                    if self._row_pad:
-                        row_leaf = row_leaf[:self.num_data]
+                    arrays, row_leaf = self.grow(
+                        self.bins,
+                        self._dist_row_vec(g[k] * self._bag_weight),
+                        self._dist_row_vec(h[k] * self._bag_weight),
+                        self._dist_row_vec(cnt), self.meta, feat_mask)
+                    row_leaf = self._local_rows(row_leaf)
+                if self._multiproc:
+                    # tree arrays are replicated — pull to host once so the
+                    # local scoring/predict paths see process-local data
+                    arrays = jax.tree.map(np.asarray, arrays)
                 num_leaves = int(arrays.num_leaves)
                 tree = Tree.from_arrays(arrays, self.train_set.used_features,
                                         self.train_set.bin_mappers,
@@ -410,6 +465,34 @@ class GBDT:
         self._bagging(it, g, h)
         return g, h, self._bag_cnt
 
+    # ---- local-rows <-> global-mesh-rows adapters (multi-process) ----------
+
+    def _dist_row_vec(self, x) -> jnp.ndarray:
+        """Local per-row vector [n_local] -> the grower's row input: padded
+        in-process, or assembled into a global row-sharded jax.Array when
+        each process holds its own partition (device-to-device: the local
+        slices are placed on their local devices, never via host)."""
+        if not self._multiproc:
+            return jnp.pad(x, (0, self._row_pad)) if self._row_pad else x
+        xl = jnp.pad(jnp.asarray(x, jnp.float32), (0, self._row_pad)) \
+            if self._row_pad else jnp.asarray(x, jnp.float32)
+        imap = self._row_sharding.addressable_devices_indices_map(
+            (self._global_rows,))
+        start0 = min(s[0].start for s in imap.values())
+        shards = [jax.device_put(xl[s[0].start - start0:s[0].stop - start0], d)
+                  for d, s in imap.items()]
+        return jax.make_array_from_single_device_arrays(
+            (self._global_rows,), self._row_sharding, shards)
+
+    def _local_rows(self, row_leaf) -> jnp.ndarray:
+        """The grower's row-sharded output -> this process's local rows."""
+        if not self._multiproc:
+            return row_leaf[:self.num_data] if self._row_pad else row_leaf
+        parts = sorted(row_leaf.addressable_shards,
+                       key=lambda s: s.index[0].start or 0)
+        local = np.concatenate([np.asarray(p.data) for p in parts])
+        return jnp.asarray(local[:self.num_data])
+
     def _shrinkage_rate(self) -> float:
         return self.config.learning_rate
 
@@ -417,7 +500,13 @@ class GBDT:
         pass
 
     def _train_tree_score(self, tree: Tree) -> jnp.ndarray:
-        """Per-row contribution of a tree on the (possibly padded) train bins."""
+        """Per-row contribution of a tree on this process's train bins."""
+        if self._multiproc:   # global sharded bins unusable in a local jit
+            if self._local_bins_cache is None:   # cached: DART/rollback reuse
+                self._local_bins_cache = jnp.asarray(self.train_set.binned)
+            return tree_scores_binned(self._local_bins_cache, tree,
+                                      self.used_feature_index, self.feat_info,
+                                      self.train_set.bin_mappers)
         s = tree_scores_binned(self.bins, tree, self.used_feature_index,
                                self.feat_info, self.train_set.bin_mappers)
         return s[:self.num_data] if self._row_pad else s
@@ -610,11 +699,10 @@ class DART(GBDT):
         self._shrinkage = config.learning_rate
 
     def _tree_score(self, tree, bins):
-        s = tree_scores_binned(bins, tree, self.used_feature_index,
-                               self.feat_info, self.train_set.bin_mappers)
-        if bins is self.bins and self._row_pad:
-            s = s[:self.num_data]
-        return s
+        if bins is self.bins:
+            return self._train_tree_score(tree)
+        return tree_scores_binned(bins, tree, self.used_feature_index,
+                                  self.feat_info, self.train_set.bin_mappers)
 
     def _select_drop(self) -> None:
         cfg = self.config

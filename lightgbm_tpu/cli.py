@@ -133,6 +133,11 @@ def main(argv: List[str] = None) -> int:
     params = parse_cli(argv)
     cfg = config_from_params(params)
     log.set_verbosity(cfg.verbose)
+    if cfg.num_machines > 1:
+        # bring the network layer up before any device work, exactly like
+        # the reference CLI (application.cpp:190-224)
+        from .parallel.mesh import init_distributed_from_config
+        init_distributed_from_config(cfg)
     task = params.get("task", "train")
     if task == "train":
         run_train(cfg, params)

@@ -27,6 +27,11 @@ from .bundling import BundleLayout, build_bundled_column, find_bundles
 from .metadata import Metadata
 
 
+def jax_process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
 class TrainingData:
     """Fully constructed binned dataset (host side)."""
 
@@ -116,20 +121,32 @@ def construct(data: np.ndarray,
             sample = np.asarray(data[sample_idx], dtype=np.float64)
         else:
             sample = np.asarray(data, dtype=np.float64)
-        for j in range(num_features):
+        # distributed FindBin (dataset_loader.cpp:737-816): with each process
+        # holding its own row partition, process p fits mappers only for
+        # features j = p (mod P) from ITS sample, then the mapper sets are
+        # allgathered so every process bins with the identical mappers
+        from ..parallel.sync import allgather_object, process_count
+        n_proc = process_count()
+        my_features = [j for j in range(num_features)
+                       if n_proc == 1 or j % n_proc == jax_process_index()]
+        fitted = {}
+        for j in my_features:
             col = sample[:, j]
             # sparse convention: pass non-zero values; zeros implied by total count
             nz = col[(col != 0) | np.isnan(col)]
             bin_type = BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL
-            mapper = BinMapper.fit(nz, total_sample_cnt=len(col),
-                                   max_bin=config.max_bin,
-                                   min_data_in_bin=config.min_data_in_bin,
-                                   min_split_data=_filter_cnt(
-                                       config, len(sample), num_data),
-                                   bin_type=bin_type,
-                                   use_missing=config.use_missing,
-                                   zero_as_missing=config.zero_as_missing)
-            ds.bin_mappers.append(mapper)
+            fitted[j] = BinMapper.fit(nz, total_sample_cnt=len(col),
+                                      max_bin=config.max_bin,
+                                      min_data_in_bin=config.min_data_in_bin,
+                                      min_split_data=_filter_cnt(
+                                          config, len(sample), num_data),
+                                      bin_type=bin_type,
+                                      use_missing=config.use_missing,
+                                      zero_as_missing=config.zero_as_missing)
+        if n_proc > 1:
+            for part in allgather_object(fitted):
+                fitted.update(part)
+        ds.bin_mappers = [fitted[j] for j in range(num_features)]
         ds.used_features = [j for j, m in enumerate(ds.bin_mappers) if not m.is_trivial]
         if not ds.used_features:
             log.fatal("Cannot construct Dataset: all features are trivial (constant)")
@@ -140,16 +157,26 @@ def construct(data: np.ndarray,
         # globally, feature-parallel expands its column window, voting
         # expands locally before casting votes (parallel/learner.py)
         if config.enable_bundle and len(ds.used_features) > 1:
-            bs = sample[:min(len(sample), 20000)]
-            nonzero = np.zeros((bs.shape[0], len(ds.used_features)), dtype=bool)
-            for k, j in enumerate(ds.used_features):
-                colv = bs[:, j]
-                nonzero[:, k] = (colv != 0) | np.isnan(colv)
-            bundles_local = find_bundles(
-                nonzero,
-                [ds.bin_mappers[j].num_bin for j in ds.used_features],
-                config.max_conflict_rate)
-            bundles = [[ds.used_features[k] for k in b] for b in bundles_local]
+            if n_proc > 1 and jax_process_index() != 0:
+                bundles = None     # rank 0 decides, everyone else receives
+            else:
+                bs = sample[:min(len(sample), 20000)]
+                nonzero = np.zeros((bs.shape[0], len(ds.used_features)),
+                                   dtype=bool)
+                for k, j in enumerate(ds.used_features):
+                    colv = bs[:, j]
+                    nonzero[:, k] = (colv != 0) | np.isnan(colv)
+                bundles_local = find_bundles(
+                    nonzero,
+                    [ds.bin_mappers[j].num_bin for j in ds.used_features],
+                    config.max_conflict_rate)
+                bundles = [[ds.used_features[k] for k in b]
+                           for b in bundles_local]
+            if n_proc > 1:
+                # the bundle plan must be identical everywhere; rank 0's
+                # local sample decides (the mapper set is already global)
+                from ..parallel.sync import broadcast_object
+                bundles = broadcast_object(bundles)
             layout = BundleLayout(bundles, ds.bin_mappers, ds.used_features)
             if layout.has_bundles:
                 ds.layout = layout

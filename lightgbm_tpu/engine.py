@@ -37,6 +37,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         num_boost_round = int(params.pop("num_iterations"))
     if "early_stopping_round" in params and params["early_stopping_round"]:
         early_stopping_rounds = int(params.pop("early_stopping_round"))
+    if int(params.get("num_machines", 1)) > 1:
+        # multi-host bring-up from config (application.cpp:190-224 analogue)
+        from .config import config_from_params
+        from .parallel.mesh import init_distributed_from_config
+        init_distributed_from_config(config_from_params(params))
     if fobj is not None:
         params.setdefault("objective", "regression")
 

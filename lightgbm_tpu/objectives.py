@@ -65,8 +65,17 @@ class Objective:
     def convert_output(self, x):
         return x
 
-    def custom_average(self) -> Optional[float]:
-        return None
+    def average_stats(self) -> Tuple[float, float]:
+        """(numerator, denominator) whose ratio is the label average that
+        boost-from-average transforms.  Expressed as two plain sums so the
+        multi-process driver can psum them globally before the transform —
+        the reference's GlobalSyncUpByMean discipline."""
+        label = np.asarray(self.labels)
+        return float(label.sum()), float(len(label))
+
+    def init_from_average(self, avg: float) -> float:
+        """Init score from the (globally agreed) label average."""
+        return float(avg)
 
     def to_string(self) -> str:
         return self.name
@@ -281,14 +290,15 @@ class CrossEntropy(Objective):
     def convert_output(self, x):
         return 1.0 / (1.0 + np.exp(-np.asarray(x)))
 
-    def custom_average(self):
+    def average_stats(self):
         label = np.asarray(self.labels)
         if self.weights is not None:
             w = np.asarray(self.weights)
-            pavg = float((label * w).sum() / w.sum())
-        else:
-            pavg = float(label.mean())
-        pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
+            return float((label * w).sum()), float(w.sum())
+        return float(label.sum()), float(len(label))
+
+    def init_from_average(self, pavg):
+        pavg = min(max(float(pavg), 1e-15), 1.0 - 1e-15)
         init = float(np.log(pavg / (1.0 - pavg)))
         log.info("[xentropy]: pavg=%f -> initscore=%f", pavg, init)
         return init
@@ -323,14 +333,15 @@ class CrossEntropyLambda(Objective):
     def convert_output(self, x):
         return np.log1p(np.exp(np.asarray(x)))
 
-    def custom_average(self):
+    def average_stats(self):
         label = np.asarray(self.labels)
         if self.weights is not None:
             w = np.asarray(self.weights)
-            havg = float((label * w).sum() / w.sum())
-        else:
-            havg = float(label.mean())
-        init = float(np.log(np.expm1(max(havg, 1e-15))))
+            return float((label * w).sum()), float(w.sum())
+        return float(label.sum()), float(len(label))
+
+    def init_from_average(self, havg):
+        init = float(np.log(np.expm1(max(float(havg), 1e-15))))
         log.info("[xentlambda]: havg=%f -> initscore=%f", havg, init)
         return init
 

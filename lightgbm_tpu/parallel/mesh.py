@@ -48,6 +48,74 @@ def init_distributed(coordinator_address: Optional[str] = None,
                                    process_id=process_id)
 
 
+def parse_machine_list(path: str):
+    """Reference mlist format (``Network::Init``, src/network/linkers.cpp):
+    one ``ip port`` pair per line."""
+    machines = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                machines.append((parts[0], int(parts[1])))
+    return machines
+
+
+def _local_rank(machines) -> Optional[int]:
+    """Find this host in the machine list by its addresses — the reference's
+    rank discovery (linkers.cpp matches local interface IPs).  The
+    ``LGBM_TPU_RANK`` env var overrides (containers often NAT their IPs)."""
+    import os
+    import socket
+    env = os.environ.get("LGBM_TPU_RANK")
+    if env is not None:
+        return int(env)
+    try:
+        local = {"127.0.0.1", "localhost", socket.gethostname(),
+                 socket.gethostbyname(socket.gethostname())}
+    except OSError:
+        local = {"127.0.0.1", "localhost"}
+    matches = [i for i, (ip, _) in enumerate(machines) if ip in local]
+    if len(matches) > 1:
+        # several workers on one host (duplicate IPs in the list): address
+        # matching cannot disambiguate — the caller must set LGBM_TPU_RANK
+        return None
+    return matches[0] if matches else None
+
+
+def init_distributed_from_config(cfg) -> bool:
+    """Wire ``machine_list_file`` / ``num_machines`` into
+    ``jax.distributed.initialize`` — the analogue of the reference CLI's
+    network bring-up (``src/application/application.cpp:190-224``).
+
+    Machine 0 is the coordinator; its listed port doubles as the JAX
+    coordination-service port.  Rank comes from ``LGBM_TPU_RANK`` or from
+    matching local addresses against the list.  Returns True when running
+    multi-process (freshly initialized or already up)."""
+    from ..utils import log
+    if getattr(cfg, "num_machines", 1) <= 1:
+        return False
+    # must not touch the backend (jax.devices/process_count) before
+    # jax.distributed.initialize; use is_initialized to test idempotently
+    if jax.distributed.is_initialized():
+        return True                      # already initialized
+    if not cfg.machine_list_file:
+        log.fatal("num_machines=%d but no machine_list_file given",
+                  cfg.num_machines)
+    machines = parse_machine_list(cfg.machine_list_file)[:cfg.num_machines]
+    if len(machines) < cfg.num_machines:
+        log.fatal("machine_list_file lists %d machines, num_machines=%d",
+                  len(machines), cfg.num_machines)
+    rank = _local_rank(machines)
+    if rank is None:
+        log.fatal("cannot determine this machine's rank: no local address in "
+                  "%s (set LGBM_TPU_RANK)", cfg.machine_list_file)
+    coordinator = f"{machines[0][0]}:{machines[0][1]}"
+    log.info("Initializing distributed runtime: %d machines, rank %d, "
+             "coordinator %s", len(machines), rank, coordinator)
+    init_distributed(coordinator, len(machines), rank)
+    return True
+
+
 def pad_rows(n: int, shards: int) -> int:
     """Rows padded so every shard gets an equal static slice."""
     return (-n) % shards

@@ -1,0 +1,48 @@
+"""Host-object synchronization across processes.
+
+The reference's distributed ``FindBin`` ships serialized ``BinMapper`` blobs
+through its Bruck allgather (``dataset_loader.cpp:737-816``: each machine
+fits mappers for its feature slice, then ``Network::Allgather`` merges).
+With jax the transport is the distributed runtime's allgather over a
+length-then-payload two-phase pickle — no hand-rolled socket layer.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, List
+
+import numpy as np
+
+
+def process_count() -> int:
+    """Number of participating processes; 1 when the distributed runtime is
+    not initialized (safe to call before backend init)."""
+    import jax
+    try:
+        if not jax.distributed.is_initialized():
+            return 1
+    except Exception:
+        return 1
+    return jax.process_count()
+
+
+def allgather_object(obj: Any) -> List[Any]:
+    """Gather one picklable host object from every process, in process-index
+    order (Network::Allgather of serialized blobs)."""
+    import jax
+    from jax.experimental import multihost_utils
+    if process_count() == 1:
+        return [obj]
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray([len(payload)], np.int64))).reshape(-1)
+    buf = np.zeros(int(lens.max()), np.uint8)
+    buf[:len(payload)] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return [pickle.loads(gathered[i, :int(lens[i])].tobytes())
+            for i in range(len(lens))]
+
+
+def broadcast_object(obj: Any) -> Any:
+    """Every process receives process 0's object (rank-0 decision sync)."""
+    return allgather_object(obj)[0]

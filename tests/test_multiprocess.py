@@ -1,0 +1,183 @@
+"""True multi-PROCESS data-parallel training over jax.distributed — the
+analogue of the reference's socket-based parallel learning
+(``examples/parallel_learning``, ``application.cpp:190-224``): two worker
+processes each hold their own row partition, train tree_learner=data through
+the config-driven network bring-up, and must produce the identical model —
+which must also match serial training on the union of the partitions."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = r"""
+import os, sys
+import numpy as np
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+rank = int(os.environ["LGBM_TPU_RANK"])
+mlist = os.environ["TEST_MLIST"]
+out = os.environ["TEST_OUT"]
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import config_from_params
+
+if os.environ.get("TEST_MODE") == "findbin":
+    # distributed FindBin vs serial fitting on identical data: mappers must
+    # be bit-identical (dataset_loader.cpp:737-816 done-criterion)
+    from lightgbm_tpu.parallel.mesh import init_distributed_from_config
+    from lightgbm_tpu.data.dataset import construct
+    import lightgbm_tpu.parallel.sync as sync
+    cfg = config_from_params(dict(num_machines=2, machine_list_file=mlist,
+                                  verbose=-1, max_bin=63))
+    init_distributed_from_config(cfg)
+    rng = np.random.RandomState(11)
+    X = np.where(rng.rand(5000, 6) < 0.3, 0.0,
+                 rng.randn(5000, 6)).astype(np.float32)
+    X[:, 0] = rng.randint(0, 9, size=5000)          # categorical-ish ints
+    y = (X.sum(1) > 0).astype(np.float32)
+    ds_dist = construct(X, cfg, label=y)
+    real_pc = sync.process_count
+    sync.process_count = lambda: 1                  # force the serial path
+    ds_serial = construct(X, cfg, label=y)
+    sync.process_count = real_pc
+    a = [m.feature_info_str() for m in ds_dist.bin_mappers]
+    b = [m.feature_info_str() for m in ds_serial.bin_mappers]
+    assert a == b, (a, b)
+    assert np.array_equal(ds_dist.binned, ds_serial.binned)
+    print("WORKER_OK", rank)
+    sys.exit(0)
+
+rng = np.random.RandomState(7)
+n, f = 3000, 8
+# discrete grid values: every partition sees the same distinct values, so
+# per-process FindBin mappers are identical by construction and the
+# distributed model is comparable to serial training nearly exactly
+X = (rng.randint(0, 24, size=(n, f)) / 4.0).astype(np.float32)
+w = rng.randn(f)
+y = ((X @ w + 2.0 * rng.randn(n)) > np.median(X @ w)).astype(np.float32)
+# this process's row partition (pre-partitioned parallel learning)
+lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
+
+params = dict(objective="binary", num_leaves=15, min_data_in_leaf=10,
+              learning_rate=0.2, verbose=-1, tree_learner="data",
+              num_machines=2, machine_list_file=mlist)
+d = lgb.Dataset(X[lo:hi], label=y[lo:hi])
+bst = lgb.train(params, d, num_boost_round=5)
+bst.save_model(out)
+# regression: boost-from-average must sync the GLOBAL label mean — the
+# partitions have different local means, so identical models across ranks
+# prove GlobalSyncUpByMean
+yr = (X @ w).astype(np.float32) + np.linspace(0, 3, n, dtype=np.float32)
+pr = dict(params, objective="regression", num_leaves=7)
+dr = lgb.Dataset(X[lo:hi], label=yr[lo:hi])
+bstr = lgb.train(pr, dr, num_boost_round=2)
+bstr.save_model(out + ".reg")
+import jax
+assert jax.process_count() == 2, jax.process_count()
+print("WORKER_OK", rank)
+"""
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_two_process_data_parallel(tmp_path):
+    port = _free_port()
+    mlist = tmp_path / "mlist.txt"
+    # reference machine-list format: "ip port" per line
+    mlist.write_text(f"127.0.0.1 {port}\n127.0.0.1 {port + 1}\n")
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(LGBM_TPU_RANK=str(rank), TEST_MLIST=str(mlist),
+                   TEST_OUT=str(tmp_path / f"model_{rank}.txt"),
+                   PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)   # exactly one device per process
+        procs.append(subprocess.Popen([sys.executable, str(script)],
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True,
+                                      env=env))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multiprocess worker hung")
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {rank}" in out
+
+    m0 = (tmp_path / "model_0.txt").read_text()
+    m1 = (tmp_path / "model_1.txt").read_text()
+    assert m0 == m1, "processes disagreed on the trained model"
+    r0 = (tmp_path / "model_0.txt.reg").read_text()
+    r1 = (tmp_path / "model_1.txt.reg").read_text()
+    assert r0 == r1, "regression init (boost_from_average) diverged"
+
+    # cross-check against serial training on the UNION of the partitions:
+    # mappers are identical by construction (discrete grid), so the
+    # data-parallel trees must match serial training up to fp reduction order
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(7)
+    n, f = 3000, 8
+    X = (rng.randint(0, 24, size=(n, f)) / 4.0).astype(np.float32)
+    w = rng.randn(f)
+    y = ((X @ w + 2.0 * rng.randn(n)) > np.median(X @ w)).astype(np.float32)
+    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=10,
+                  learning_rate=0.2, verbose=-1)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+    dist = lgb.Booster(model_str=m0)
+    ps = bst.predict(X[:500])
+    pd = dist.predict(X[:500])
+    np.testing.assert_allclose(pd, ps, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_distributed_findbin_matches_serial(tmp_path):
+    """Both processes hold the SAME data: sharded-then-allgathered mappers
+    must equal serially fitted ones bit-for-bit, and binning must agree."""
+    port = _free_port()
+    mlist = tmp_path / "mlist.txt"
+    mlist.write_text(f"127.0.0.1 {port}\n127.0.0.1 {port + 1}\n")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(LGBM_TPU_RANK=str(rank), TEST_MLIST=str(mlist),
+                   TEST_OUT=str(tmp_path / f"unused_{rank}.txt"),
+                   TEST_MODE="findbin",
+                   PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen([sys.executable, str(script)],
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True,
+                                      env=env))
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("findbin worker hung")
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {rank}" in out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
