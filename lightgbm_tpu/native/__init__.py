@@ -21,29 +21,35 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "gbt_native.cpp")
+_SRC_TRAIN = os.path.join(_DIR, "gbt_capi_train.cpp")
 _LIB_PATH = os.path.join(_DIR, "_gbt_native.so")
 
 _lock = threading.Lock()
 _lib = None
 _load_failed = False
+_has_train_api = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-std=c++11", "-shared", "-fPIC", "-fopenmp",
-           _SRC, "-o", _LIB_PATH]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
-    except (OSError, subprocess.TimeoutExpired):
-        return False
-    if proc.returncode != 0:
-        # retry without OpenMP (toolchains without libgomp)
-        cmd = [c for c in cmd if c != "-fopenmp"]
+    import sysconfig
+    base = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH]
+    # preferred: serving runtime + the CPython-embedding training ABI
+    with_train = base + ["-std=c++14", "-fopenmp", _SRC, _SRC_TRAIN,
+                         "-I" + sysconfig.get_paths()["include"]]
+    # fallbacks: no training shim (no Python headers), then no OpenMP
+    attempts = [with_train,
+                [c for c in with_train if c != "-fopenmp"],
+                base + ["-std=c++11", "-fopenmp", _SRC],
+                base + ["-std=c++11", _SRC]]
+    for cmd in attempts:
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=300)
         except (OSError, subprocess.TimeoutExpired):
             return False
-    return proc.returncode == 0
+        if proc.returncode == 0:
+            return True
+    return False
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -92,6 +98,35 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.GBTN_FreeModel.argtypes = [c_p]
     lib.GBTN_OpenMPThreads.restype = c_i
     lib.GBTN_OpenMPThreads.argtypes = []
+
+    # training ABI (absent when built without Python headers)
+    global _has_train_api
+    try:
+        lib.GBTN_GetLastError.restype = ctypes.c_char_p
+        lib.GBTN_GetLastError.argtypes = []
+        lib.GBTN_DatasetCreateFromMat.restype = c_i
+        lib.GBTN_DatasetCreateFromMat.argtypes = [
+            c_d_p, c_ll, c_i, ctypes.c_char_p, c_f_p,
+            ctypes.POINTER(c_p)]
+        lib.GBTN_DatasetFree.restype = c_i
+        lib.GBTN_DatasetFree.argtypes = [c_p]
+        lib.GBTN_BoosterCreate.restype = c_i
+        lib.GBTN_BoosterCreate.argtypes = [c_p, ctypes.c_char_p,
+                                           ctypes.POINTER(c_p)]
+        lib.GBTN_BoosterUpdateOneIter.restype = c_i
+        lib.GBTN_BoosterUpdateOneIter.argtypes = [c_p, c_i_p]
+        lib.GBTN_BoosterSaveModel.restype = c_i
+        lib.GBTN_BoosterSaveModel.argtypes = [c_p, c_i, ctypes.c_char_p]
+        lib.GBTN_BoosterPredictForMat.restype = c_i
+        lib.GBTN_BoosterPredictForMat.argtypes = [c_p, c_d_p, c_ll, c_i,
+                                                  c_d_p]
+        lib.GBTN_BoosterGetNumClass.restype = c_i
+        lib.GBTN_BoosterGetNumClass.argtypes = [c_p, c_i_p]
+        lib.GBTN_BoosterFree.restype = c_i
+        lib.GBTN_BoosterFree.argtypes = [c_p]
+        _has_train_api = True
+    except AttributeError:
+        _has_train_api = False
     return lib
 
 
@@ -107,8 +142,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _load_failed = True
             return None
         try:
+            src_mtime = max(os.path.getmtime(_SRC),
+                            os.path.getmtime(_SRC_TRAIN)
+                            if os.path.exists(_SRC_TRAIN) else 0.0)
             if (not os.path.exists(_LIB_PATH)
-                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+                    or os.path.getmtime(_LIB_PATH) < src_mtime):
                 if not _build():
                     _load_failed = True
                     return None
@@ -121,6 +159,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def train_api_available() -> bool:
+    """True when the training C ABI (gbt_capi_train.cpp) was built in."""
+    return get_lib() is not None and _has_train_api
 
 
 # ---------------------------------------------------------------- wrappers

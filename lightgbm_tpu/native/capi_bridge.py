@@ -1,0 +1,63 @@
+"""Python side of the training C ABI (gbt_capi_train.cpp).
+
+Each function is called from the C shim with plain buffers/handles and
+delegates into the engine — mirroring how the reference's ``c_api.cpp`` is a
+thin shim over its C++ ``GBDT`` (``include/LightGBM/c_api.h:37-719``).
+Buffers arriving from C are COPIED before use: the caller may free them as
+soon as the call returns (reference ``LGBM_DatasetCreateFromMat`` contract).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def _parse_params(params: str) -> Dict[str, str]:
+    """Space-separated ``key=value`` pairs — the reference c_api params
+    convention (c_api.cpp ConfigStr2Map)."""
+    out: Dict[str, str] = {}
+    for tok in (params or "").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def dataset_from_mat(mv_data, nrow, ncol, params, mv_label):
+    from ..basic import Dataset
+    X = np.frombuffer(mv_data, dtype=np.float64,
+                      count=nrow * ncol).reshape(nrow, ncol).copy()
+    label = (None if mv_label is None
+             else np.frombuffer(mv_label, dtype=np.float32,
+                                count=nrow).copy())
+    return Dataset(X, label=label, params=_parse_params(params))
+
+
+def booster_create(dataset, params):
+    from ..basic import Booster
+    return Booster(params=_parse_params(params), train_set=dataset)
+
+
+def booster_update(booster) -> bool:
+    return bool(booster.update())
+
+
+def booster_save(booster, num_iteration, filename) -> bool:
+    booster.save_model(filename, num_iteration=num_iteration)
+    return True
+
+
+def booster_num_class(booster) -> int:
+    return int(max(booster.inner.num_class, 1))
+
+
+def booster_predict_into(booster, mv_in, nrow, ncol, mv_out) -> bool:
+    X = np.frombuffer(mv_in, dtype=np.float64,
+                      count=nrow * ncol).reshape(nrow, ncol)
+    pred = np.asarray(booster.predict(X), dtype=np.float64)
+    k = booster_num_class(booster)
+    out = np.frombuffer(mv_out, dtype=np.float64,
+                        count=nrow * k).reshape(nrow, k)
+    out[:] = pred.reshape(nrow, k)
+    return True

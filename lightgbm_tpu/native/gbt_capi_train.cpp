@@ -1,0 +1,218 @@
+// Training C ABI — the analogue of the reference's training c_api surface
+// (include/LightGBM/c_api.h: LGBM_DatasetCreateFromMat, LGBM_BoosterCreate,
+// LGBM_BoosterUpdateOneIter, LGBM_BoosterSaveModel, ...).
+//
+// Architecture note: the reference's c_api.cpp is a thin C shim over its C++
+// GBDT runtime.  Here the training runtime IS the JAX/XLA engine, so the C
+// shim delegates into it through CPython embedding: handles are Python
+// objects, every entry point bridges via lightgbm_tpu.native.capi_bridge.
+// A standalone C program gets a working training ABI (the interpreter is
+// bootstrapped on first use); in-process (ctypes) callers share the live
+// interpreter.  The serving-side functions (GBTN_Predict & co in
+// gbt_native.cpp) stay pure C++ with no Python dependency.
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+// Bootstraps the interpreter for standalone C callers; no-op in-process.
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    if (!Py_IsInitialized()) {
+      g_last_error = "failed to initialize the Python runtime";
+      return false;
+    }
+    // release the GIL acquired by initialization so OTHER caller threads
+    // can enter through PyGILState_Ensure (multithreaded standalone use)
+    PyEval_SaveThread();
+  }
+  return true;
+}
+
+// Calls lightgbm_tpu.native.capi_bridge.<fn>(*args).  Returns a new
+// reference, or nullptr with g_last_error set.
+PyObject* call_bridge(const char* fn, PyObject* args) {
+  if (args == nullptr) {   // failed Py_BuildValue / memoryview construction
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* mod = PyImport_ImportModule("lightgbm_tpu.native.capi_bridge");
+  if (mod == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (out == nullptr) set_error_from_python();
+  return out;
+}
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() : state(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state); }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* GBTN_GetLastError() { return g_last_error.c_str(); }
+
+// data: row-major [nrow, ncol] f64; label: [nrow] f32 or null.
+// params: space-separated key=value pairs (reference c_api convention).
+// On success *out is a dataset handle; returns 0, else -1.
+int GBTN_DatasetCreateFromMat(const double* data, long long nrow, int ncol,
+                              const char* params, const float* label,
+                              void** out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* mv_data = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<double*>(data)),
+      static_cast<Py_ssize_t>(nrow) * ncol * sizeof(double), PyBUF_READ);
+  PyObject* mv_label =
+      label == nullptr
+          ? (Py_INCREF(Py_None), Py_None)
+          : PyMemoryView_FromMemory(
+                reinterpret_cast<char*>(const_cast<float*>(label)),
+                static_cast<Py_ssize_t>(nrow) * sizeof(float), PyBUF_READ);
+  PyObject* args = Py_BuildValue("(OLisO)", mv_data, nrow, ncol,
+                                 params == nullptr ? "" : params, mv_label);
+  Py_XDECREF(mv_data);
+  Py_XDECREF(mv_label);
+  PyObject* ds = call_bridge("dataset_from_mat", args);
+  if (ds == nullptr) return -1;
+  *out = ds;  // owned reference == handle
+  return 0;
+}
+
+int GBTN_DatasetFree(void* handle) {
+  if (!Py_IsInitialized() || handle == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+int GBTN_BoosterCreate(void* dataset, const char* params, void** out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Os)", static_cast<PyObject*>(dataset),
+      params == nullptr ? "" : params);
+  PyObject* bst = call_bridge("booster_create", args);
+  if (bst == nullptr) return -1;
+  *out = bst;
+  return 0;
+}
+
+// *is_finished = 1 when no further splits are possible (reference
+// LGBM_BoosterUpdateOneIter contract).
+int GBTN_BoosterUpdateOneIter(void* booster, int* is_finished) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(booster));
+  PyObject* r = call_bridge("booster_update", args);
+  if (r == nullptr) return -1;
+  if (is_finished != nullptr) *is_finished = PyObject_IsTrue(r) ? 1 : 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int GBTN_BoosterSaveModel(void* booster, int num_iteration,
+                          const char* filename) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Ois)", static_cast<PyObject*>(booster),
+                                 num_iteration, filename);
+  PyObject* r = call_bridge("booster_save", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// out must hold nrow * num_class doubles (transformed predictions).
+int GBTN_BoosterPredictForMat(void* booster, const double* data,
+                              long long nrow, int ncol, double* out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* mv_in = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<double*>(data)),
+      static_cast<Py_ssize_t>(nrow) * ncol * sizeof(double), PyBUF_READ);
+  PyObject* r = nullptr;
+  {
+    PyObject* num_class =
+        call_bridge("booster_num_class",
+                    Py_BuildValue("(O)", static_cast<PyObject*>(booster)));
+    if (num_class == nullptr) {
+      Py_XDECREF(mv_in);
+      return -1;
+    }
+    long k = PyLong_AsLong(num_class);
+    Py_DECREF(num_class);
+    PyObject* mv_out = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(out),
+        static_cast<Py_ssize_t>(nrow) * k * sizeof(double), PyBUF_WRITE);
+    PyObject* args = Py_BuildValue("(OOLiO)",
+                                   static_cast<PyObject*>(booster), mv_in,
+                                   nrow, ncol, mv_out);
+    Py_XDECREF(mv_out);
+    r = call_bridge("booster_predict_into", args);
+  }
+  Py_XDECREF(mv_in);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int GBTN_BoosterGetNumClass(void* booster, int* out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* r = call_bridge(
+      "booster_num_class",
+      Py_BuildValue("(O)", static_cast<PyObject*>(booster)));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int GBTN_BoosterFree(void* handle) {
+  if (!Py_IsInitialized() || handle == nullptr) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+}  // extern "C"
