@@ -16,7 +16,7 @@ from . import data as data_mod
 from .boosting import GBDT, create_boosting
 from .config import Config, canonicalize_params, config_from_params
 from .data.dataset import TrainingData, construct
-from .data.parser import load_text_file
+from .data.parser import load_text_file, read_header_names
 from .objectives import create_objective
 from .utils import log
 
@@ -58,6 +58,43 @@ class Dataset:
         if self._constructed is not None:
             return self
         cfg = config or config_from_params(self.params)
+        if (isinstance(self.data, (str, os.PathLike))
+                and cfg.use_two_round_loading and self.reference is None):
+            # two-round streamed loading (dataset_loader.cpp:181-207): the
+            # raw float matrix never materializes — sample pass, then a
+            # chunked bin-as-you-read pass into the final uint8/16 matrix
+            path = str(self.data)
+            meta_probe = data_mod.Metadata(0)
+            meta_probe.load_side_files(path)
+            names = (list(self.feature_name)
+                     if isinstance(self.feature_name, (list, tuple))
+                     else (read_header_names(path, 0) if cfg.has_header
+                           else None))
+            cat_idx: List[int] = []
+            if isinstance(self.categorical_feature, (list, tuple)):
+                for c in self.categorical_feature:
+                    if isinstance(c, str) and names and c in names:
+                        cat_idx.append(names.index(c))
+                    elif not isinstance(c, str):
+                        cat_idx.append(int(c))
+            self._constructed = data_mod.construct_streamed(
+                path, cfg,
+                label=(None if self.label is None
+                       else np.asarray(self.label, np.float32).ravel()),
+                weight=meta_probe.weight if self.weight is None
+                else np.asarray(self.weight),
+                group=(np.diff(meta_probe.query_boundaries)
+                       if self.group is None
+                       and meta_probe.query_boundaries is not None
+                       else self.group),
+                init_score=meta_probe.init_score if self.init_score is None
+                else np.asarray(self.init_score),
+                feature_names=names, categorical_features=cat_idx)
+            self.label = self._constructed.metadata.label
+            self.raw = None
+            if self.free_raw_data:
+                self.data = None
+            return self
         if isinstance(self.data, (str, os.PathLike)):
             path = str(self.data)
             feats, labels, names = load_text_file(

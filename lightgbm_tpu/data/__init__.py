@@ -1,4 +1,4 @@
 from . import binning, dataset, metadata, parser  # noqa: F401
 from .binning import BinMapper  # noqa: F401
-from .dataset import TrainingData, construct  # noqa: F401
+from .dataset import TrainingData, construct, construct_streamed  # noqa: F401
 from .metadata import Metadata  # noqa: F401

@@ -121,94 +121,121 @@ def construct(data: np.ndarray,
             sample = np.asarray(data[sample_idx], dtype=np.float64)
         else:
             sample = np.asarray(data, dtype=np.float64)
-        # distributed FindBin (dataset_loader.cpp:737-816): with each process
-        # holding its own row partition, process p fits mappers only for
-        # features j = p (mod P) from ITS sample, then the mapper sets are
-        # allgathered so every process bins with the identical mappers
-        from ..parallel.sync import allgather_object, process_count
-        n_proc = process_count()
-        my_features = [j for j in range(num_features)
-                       if n_proc == 1 or j % n_proc == jax_process_index()]
-        fitted = {}
-        for j in my_features:
-            col = sample[:, j]
-            # sparse convention: pass non-zero values; zeros implied by total count
-            nz = col[(col != 0) | np.isnan(col)]
-            bin_type = BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL
-            fitted[j] = BinMapper.fit(nz, total_sample_cnt=len(col),
-                                      max_bin=config.max_bin,
-                                      min_data_in_bin=config.min_data_in_bin,
-                                      min_split_data=_filter_cnt(
-                                          config, len(sample), num_data),
-                                      bin_type=bin_type,
-                                      use_missing=config.use_missing,
-                                      zero_as_missing=config.zero_as_missing)
-        if n_proc > 1:
-            for part in allgather_object(fitted):
-                fitted.update(part)
-        ds.bin_mappers = [fitted[j] for j in range(num_features)]
-        ds.used_features = [j for j, m in enumerate(ds.bin_mappers) if not m.is_trivial]
-        if not ds.used_features:
-            log.fatal("Cannot construct Dataset: all features are trivial (constant)")
-
-        # EFB: greedily bundle mutually-exclusive sparse features
-        # (FindGroups/FastFeatureBundling, dataset.cpp:66-210).  All tree
-        # learners consume bundles: serial/data expand physical histograms
-        # globally, feature-parallel expands its column window, voting
-        # expands locally before casting votes (parallel/learner.py)
-        if config.enable_bundle and len(ds.used_features) > 1:
-            if n_proc > 1 and jax_process_index() != 0:
-                bundles = None     # rank 0 decides, everyone else receives
-            else:
-                bs = sample[:min(len(sample), 20000)]
-                nonzero = np.zeros((bs.shape[0], len(ds.used_features)),
-                                   dtype=bool)
-                for k, j in enumerate(ds.used_features):
-                    colv = bs[:, j]
-                    nonzero[:, k] = (colv != 0) | np.isnan(colv)
-                bundles_local = find_bundles(
-                    nonzero,
-                    [ds.bin_mappers[j].num_bin for j in ds.used_features],
-                    config.max_conflict_rate)
-                bundles = [[ds.used_features[k] for k in b]
-                           for b in bundles_local]
-            if n_proc > 1:
-                # the bundle plan must be identical everywhere; rank 0's
-                # local sample decides (the mapper set is already global)
-                from ..parallel.sync import broadcast_object
-                bundles = broadcast_object(bundles)
-            layout = BundleLayout(bundles, ds.bin_mappers, ds.used_features)
-            if layout.has_bundles:
-                ds.layout = layout
-                ds.used_features = layout.sub_features
-                log.info("EFB bundled %d features into %d columns",
-                         len(layout.sub_features), layout.num_columns)
+        _fit_from_sample(ds, sample, config, cat_set)
 
     # bin all columns (native OpenMP binner when available)
     dtype = np.uint8 if ds.max_num_bin() <= 256 else np.uint16
-    col_buf = np.empty(num_data, dtype=dtype)
+    ncols = (ds.layout.num_columns
+             if ds.layout is not None and ds.layout.has_bundles
+             else len(ds.used_features))
+    binned = np.empty((num_data, ncols), dtype=dtype)
+    _bin_rows(ds, np.asarray(data), binned)
+    ds.binned = binned
+
+    _set_metadata(ds, num_data, label, weight, group, init_score)
+    return ds
+
+
+def _fit_from_sample(ds: TrainingData, sample: np.ndarray, config: Config,
+                     cat_set) -> None:
+    """Fit per-feature BinMappers from the sampled rows, filter trivial
+    features, and decide the EFB bundle layout (FindBin + FindGroups)."""
+    num_features = ds.num_total_features
+    num_data = ds.num_data
+    # distributed FindBin (dataset_loader.cpp:737-816): with each process
+    # holding its own row partition, process p fits mappers only for
+    # features j = p (mod P) from ITS sample, then the mapper sets are
+    # allgathered so every process bins with the identical mappers
+    from ..parallel.sync import allgather_object, process_count
+    n_proc = process_count()
+    my_features = [j for j in range(num_features)
+                   if n_proc == 1 or j % n_proc == jax_process_index()]
+    fitted = {}
+    for j in my_features:
+        col = sample[:, j]
+        # sparse convention: pass non-zero values; zeros implied by total count
+        nz = col[(col != 0) | np.isnan(col)]
+        bin_type = BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL
+        fitted[j] = BinMapper.fit(nz, total_sample_cnt=len(col),
+                                  max_bin=config.max_bin,
+                                  min_data_in_bin=config.min_data_in_bin,
+                                  min_split_data=_filter_cnt(
+                                      config, len(sample), num_data),
+                                  bin_type=bin_type,
+                                  use_missing=config.use_missing,
+                                  zero_as_missing=config.zero_as_missing)
+    if n_proc > 1:
+        for part in allgather_object(fitted):
+            fitted.update(part)
+    ds.bin_mappers = [fitted[j] for j in range(num_features)]
+    ds.used_features = [j for j, m in enumerate(ds.bin_mappers)
+                        if not m.is_trivial]
+    if not ds.used_features:
+        log.fatal("Cannot construct Dataset: all features are trivial (constant)")
+
+    # EFB: greedily bundle mutually-exclusive sparse features
+    # (FindGroups/FastFeatureBundling, dataset.cpp:66-210).  All tree
+    # learners consume bundles: serial/data expand physical histograms
+    # globally, feature-parallel expands its column window, voting
+    # expands locally before casting votes (parallel/learner.py)
+    if config.enable_bundle and len(ds.used_features) > 1:
+        if n_proc > 1 and jax_process_index() != 0:
+            bundles = None     # rank 0 decides, everyone else receives
+        else:
+            bs = sample[:min(len(sample), 20000)]
+            nonzero = np.zeros((bs.shape[0], len(ds.used_features)),
+                               dtype=bool)
+            for k, j in enumerate(ds.used_features):
+                colv = bs[:, j]
+                nonzero[:, k] = (colv != 0) | np.isnan(colv)
+            bundles_local = find_bundles(
+                nonzero,
+                [ds.bin_mappers[j].num_bin for j in ds.used_features],
+                config.max_conflict_rate)
+            bundles = [[ds.used_features[k] for k in b]
+                       for b in bundles_local]
+        if n_proc > 1:
+            # the bundle plan must be identical everywhere; rank 0's
+            # local sample decides (the mapper set is already global)
+            from ..parallel.sync import broadcast_object
+            bundles = broadcast_object(bundles)
+        layout = BundleLayout(bundles, ds.bin_mappers, ds.used_features)
+        if layout.has_bundles:
+            ds.layout = layout
+            ds.used_features = layout.sub_features
+            log.info("EFB bundled %d features into %d columns",
+                     len(layout.sub_features), layout.num_columns)
+
+
+def _bin_rows(ds: TrainingData, data: np.ndarray, out: np.ndarray) -> None:
+    """Bin a block of raw rows into ``out`` (same row count) using the
+    fitted mappers/layout — shared by the in-memory path and each chunk of
+    the streamed two-round path."""
+    n = data.shape[0]
+    dtype = out.dtype
+    col_buf = np.empty(n, dtype=dtype)
     if ds.layout is not None and ds.layout.has_bundles:
         lay = ds.layout
-        binned = np.empty((num_data, lay.num_columns), dtype=dtype)
         for col, bundle in enumerate(lay.bundles):
             if len(bundle) == 1:
                 ds.bin_mappers[bundle[0]].bin_into(
                     np.asarray(data[:, bundle[0]], dtype=np.float64), col_buf)
-                binned[:, col] = col_buf
+                out[:, col] = col_buf
             else:
                 offsets = [lay.sub_offset[k]
                            for k in range(len(lay.sub_col))
                            if lay.sub_col[k] == col]
-                binned[:, col] = build_bundled_column(
+                out[:, col] = build_bundled_column(
                     data, bundle, ds.bin_mappers, offsets, dtype, col_buf)
     else:
-        binned = np.empty((num_data, len(ds.used_features)), dtype=dtype)
         for out_j, j in enumerate(ds.used_features):
             ds.bin_mappers[j].bin_into(
                 np.asarray(data[:, j], dtype=np.float64), col_buf)
-            binned[:, out_j] = col_buf
-    ds.binned = binned
+            out[:, out_j] = col_buf
 
+
+def _set_metadata(ds: TrainingData, num_data: int, label, weight, group,
+                  init_score) -> None:
     ds.metadata = Metadata(num_data)
     if label is not None:
         ds.metadata.set_label(label)
@@ -217,6 +244,77 @@ def construct(data: np.ndarray,
     ds.metadata.set_weight(weight)
     ds.metadata.set_query(group)
     ds.metadata.set_init_score(init_score)
+
+
+def construct_streamed(path: str,
+                       config: Config,
+                       label: Optional[np.ndarray] = None,
+                       weight: Optional[np.ndarray] = None,
+                       group: Optional[np.ndarray] = None,
+                       init_score: Optional[np.ndarray] = None,
+                       feature_names: Optional[Sequence[str]] = None,
+                       categorical_features: Optional[Sequence[int]] = None,
+                       label_idx: int = 0,
+                       chunk_rows: int = 200_000) -> TrainingData:
+    """Two-round streamed construction from a text file
+    (``use_two_round_loading``; dataset_loader.cpp:181-207, 265+).
+
+    Round 1 streams the file once to pull the sampled rows (indices chosen
+    exactly like the in-memory path, so mappers are bit-identical) and all
+    labels; round 2 streams again, binning each chunk straight into the
+    preallocated uint8/16 matrix.  Peak memory is the binned matrix plus one
+    raw chunk — the full float64 feature matrix never exists."""
+    from .parser import count_data_rows, iter_parsed_chunks
+
+    num_data, num_features = count_data_rows(path, config.has_header,
+                                             label_idx)
+    ds = TrainingData()
+    ds.num_data = num_data
+    ds.num_total_features = num_features
+    ds.feature_names = (list(feature_names) if feature_names
+                        else [f"Column_{i}" for i in range(num_features)])
+    cat_set = set(int(c) for c in (categorical_features or []))
+
+    sample_cnt = min(config.bin_construct_sample_cnt, num_data)
+    rng = make_rng(config.data_random_seed)
+    sample_idx = (sample_k(rng, num_data, sample_cnt)
+                  if sample_cnt < num_data
+                  else np.arange(num_data))
+
+    # ---- round 1: sampled rows + labels ------------------------------------
+    sample = np.empty((len(sample_idx), num_features), dtype=np.float64)
+    labels = np.empty(num_data, dtype=np.float32)
+    row0 = 0
+    for feats, labs in iter_parsed_chunks(path, config.has_header, label_idx,
+                                          chunk_rows, ncol=num_features):
+        row1 = row0 + len(labs)
+        labels[row0:row1] = labs
+        lo = np.searchsorted(sample_idx, row0)
+        hi = np.searchsorted(sample_idx, row1)
+        if hi > lo:
+            sample[lo:hi] = feats[sample_idx[lo:hi] - row0]
+        row0 = row1
+    if row0 != num_data:
+        log.fatal("Streamed loading row mismatch: counted %d, parsed %d",
+                  num_data, row0)
+    _fit_from_sample(ds, sample, config, cat_set)
+    del sample
+
+    # ---- round 2: bin chunks straight into the final matrix ----------------
+    dtype = np.uint8 if ds.max_num_bin() <= 256 else np.uint16
+    ncols = (ds.layout.num_columns
+             if ds.layout is not None and ds.layout.has_bundles
+             else len(ds.used_features))
+    binned = np.empty((num_data, ncols), dtype=dtype)
+    row0 = 0
+    for feats, _ in iter_parsed_chunks(path, config.has_header, label_idx,
+                                       chunk_rows, ncol=num_features):
+        _bin_rows(ds, feats, binned[row0:row0 + len(feats)])
+        row0 += len(feats)
+    ds.binned = binned
+
+    _set_metadata(ds, num_data, labels if label is None else label,
+                  weight, group, init_score)
     return ds
 
 

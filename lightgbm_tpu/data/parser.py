@@ -15,6 +15,39 @@ import numpy as np
 from ..utils import log
 
 
+NA_VALUES = ["", "na", "nan", "NA", "NaN", "null"]
+
+
+def _read_head(path: str, n_lines: int = 32) -> List[str]:
+    """First lines of a file for sniffing; fatal on an empty file."""
+    with open(path, "r") as f:
+        head = [line for _, line in zip(range(n_lines), f)]
+    if not head:
+        log.fatal("Data file %s is empty", path)
+    return head
+
+
+def sniff_file(path: str, has_header: bool) -> Tuple[str, int]:
+    """(format, num_columns) for a data file — blank lines skipped."""
+    head = _read_head(path)
+    start = 1 if has_header else 0
+    return _sniff_format(head[start:] or head)
+
+
+def read_header_names(path: str, label_idx: int = 0) -> Optional[List[str]]:
+    """Column names from a header line, label column removed (None for
+    libsvm, which has no per-column header)."""
+    head = _read_head(path)
+    fmt, _ = _sniff_format(head[1:] or head)
+    if fmt == "libsvm":
+        return None
+    sep = "," if fmt == "csv" else "\t"
+    names = [t.strip() for t in head[0].strip().split(sep)]
+    if label_idx >= 0:
+        names = [h for i, h in enumerate(names) if i != label_idx]
+    return names
+
+
 def _sniff_format(lines: List[str]) -> Tuple[str, int]:
     """Return (format, num_columns). format in {csv, tsv, libsvm}."""
     for line in lines:
@@ -42,15 +75,7 @@ def load_text_file(path: str, has_header: bool = False,
     Missing values (empty CSV cells, "na"/"nan") become NaN.  LibSVM zero
     default is 0.0 as in the reference.
     """
-    with open(path, "r") as f:
-        head = []
-        for _ in range(32):
-            line = f.readline()
-            if not line:
-                break
-            head.append(line)
-    if not head:
-        log.fatal("Data file %s is empty", path)
+    head = _read_head(path)
     start = 1 if has_header else 0
     fmt, _ = _sniff_format(head[start:] or head)
 
@@ -78,7 +103,7 @@ def load_text_file(path: str, has_header: bool = False,
     def conv(text: str) -> np.ndarray:
         return np.genfromtxt(io.StringIO(text), delimiter=delim,
                              skip_header=start, dtype=np.float64,
-                             missing_values=["", "na", "nan", "NA", "NaN", "null"],
+                             missing_values=NA_VALUES,
                              filling_values=np.nan)
 
     with open(path, "r") as f:
@@ -94,6 +119,95 @@ def load_text_file(path: str, has_header: bool = False,
         labels = np.zeros(mat.shape[0], dtype=np.float32)
         features = mat
     return features, labels, header_names
+
+
+def count_data_rows(path: str, has_header: bool,
+                    label_idx: int = 0) -> Tuple[int, int]:
+    """Round-0 scan of the streamed loader: (num_rows, num_features)
+    without materializing any floats (dataset_loader.cpp CountLine).
+
+    CSV/TSV: a newline scan plus the sniffed column count.  LibSVM: the
+    scan must also tokenize to learn the feature-space width (the maximum
+    index may appear on any line) — the price of a headerless sparse
+    format."""
+    fmt, ncol = sniff_file(path, has_header)
+    n = 0
+    if fmt == "libsvm":
+        max_idx = -1
+        with open(path, "r") as f:
+            if has_header:
+                f.readline()
+            for line in f:
+                if not line.strip():
+                    continue
+                n += 1
+                for tok in line.split():
+                    i, _, _v = tok.partition(":")
+                    if _v and i.isdigit():
+                        idx = int(i)
+                        if idx > max_idx:
+                            max_idx = idx
+        return n, max_idx + 1
+    with open(path, "r") as f:
+        if has_header:
+            f.readline()
+        for line in f:
+            if line.strip():
+                n += 1
+    return n, ncol - (1 if label_idx >= 0 else 0)
+
+
+def iter_parsed_chunks(path: str, has_header: bool, label_idx: int,
+                       chunk_rows: int = 200_000, ncol: int = None):
+    """Stream (features [c, F] f64, labels [c] f32) chunks — the per-chunk
+    worker of the two-round loader.  ``ncol`` fixes the feature count
+    (required for libsvm, where any single chunk may not witness the
+    maximum feature index)."""
+    fmt, _ = sniff_file(path, has_header)
+
+    def flush_csv(lines):
+        mat = np.genfromtxt(io.StringIO("".join(lines)),
+                            delimiter="," if fmt == "csv" else None,
+                            dtype=np.float64,
+                            missing_values=NA_VALUES,
+                            filling_values=np.nan)
+        if mat.ndim == 1:
+            mat = mat.reshape(len(lines), -1)
+        if label_idx >= 0:
+            return (np.delete(mat, label_idx, axis=1),
+                    mat[:, label_idx].astype(np.float32))
+        return mat, np.zeros(len(mat), dtype=np.float32)
+
+    def flush_libsvm(lines):
+        feats = np.zeros((len(lines), ncol), dtype=np.float64)
+        labs = np.zeros(len(lines), dtype=np.float32)
+        for r, line in enumerate(lines):
+            toks = line.split()
+            if label_idx >= 0 and toks and ":" not in toks[0]:
+                labs[r] = float(toks[0])
+                toks = toks[1:]
+            for t in toks:
+                i, _, v = t.partition(":")
+                # non-numeric ids (e.g. ranking "qid:3") are skipped, same
+                # as in the counting pass
+                if v and i.isdigit():
+                    feats[r, int(i)] = float(v)
+        return feats, labs
+
+    flush = flush_libsvm if fmt == "libsvm" else flush_csv
+    buf = []
+    with open(path, "r") as f:
+        if has_header:
+            f.readline()
+        for line in f:
+            if not line.strip():
+                continue
+            buf.append(line)
+            if len(buf) >= chunk_rows:
+                yield flush(buf)
+                buf = []
+    if buf:
+        yield flush(buf)
 
 
 def _load_libsvm(path: str, has_header: bool, label_idx: int) -> Tuple[np.ndarray, np.ndarray]:

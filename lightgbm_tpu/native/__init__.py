@@ -31,13 +31,22 @@ _has_train_api = False
 
 
 def _build() -> bool:
+    import sys
     import sysconfig
     base = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH]
-    # preferred: serving runtime + the CPython-embedding training ABI
+    # preferred: serving runtime + the CPython-embedding training ABI,
+    # linked against libpython so standalone C callers (and hosts whose
+    # python binary does not re-export libpython symbols) resolve Py_*
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    pylib = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    link = ([f"-L{libdir}", f"-l{pylib}", f"-Wl,-rpath,{libdir}"]
+            if libdir else [])
     with_train = base + ["-std=c++14", "-fopenmp", _SRC, _SRC_TRAIN,
-                         "-I" + sysconfig.get_paths()["include"]]
-    # fallbacks: no training shim (no Python headers), then no OpenMP
+                         "-I" + sysconfig.get_paths()["include"]] + link
+    # fallbacks: unlinked shim (static-python hosts), no training shim
+    # (no Python headers), then no OpenMP
     attempts = [with_train,
+                [c for c in with_train if c not in link],
                 [c for c in with_train if c != "-fopenmp"],
                 base + ["-std=c++11", "-fopenmp", _SRC],
                 base + ["-std=c++11", _SRC]]
