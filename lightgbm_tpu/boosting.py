@@ -29,7 +29,8 @@ from .data.dataset import TrainingData
 from .grower import FeatureMeta, GrowerConfig, make_grower
 from .metrics import Metric, create_metric, default_metric_for_objective
 from .objectives import Objective, create_objective, parse_objective_string
-from .predictor import Predictor, predict_binned_leaf, tree_scores_binned
+from .predictor import (Predictor, predict_binned_leaf, tree_scores_binned,
+                        trees_scores_binned)
 from .tree import Tree
 from .utils import log
 from .utils.random import make_rng, sample_k
@@ -687,8 +688,11 @@ class GBDT:
 
 
 class DART(GBDT):
-    """dart.hpp — Dropouts meet MART."""
-    sub_model_name = "dart"
+    """dart.hpp — Dropouts meet MART.
+
+    Model files still start with "tree" like every reference boosting type
+    (no SubModelName override exists in the reference; a DART model file IS
+    just its trees, already normalized)."""
 
     def __init__(self, config, train_set=None, objective=None):
         super().__init__(config, train_set, objective)
@@ -698,11 +702,18 @@ class DART(GBDT):
         self._drop_index: List[int] = []
         self._shrinkage = config.learning_rate
 
-    def _tree_score(self, tree, bins):
-        if bins is self.bins:
-            return self._train_tree_score(tree)
-        return tree_scores_binned(bins, tree, self.used_feature_index,
+    def _trees_scores(self, trees, bins) -> jnp.ndarray:
+        """Batched [T, N] contributions (one vmapped call for all dropped
+        trees — the drop/normalize walk is per-iteration hot path)."""
+        if bins is self.bins and self._multiproc:
+            if self._local_bins_cache is None:
+                self._local_bins_cache = jnp.asarray(self.train_set.binned)
+            bins = self._local_bins_cache
+        out = trees_scores_binned(bins, trees, self.used_feature_index,
                                   self.feat_info, self.train_set.bin_mappers)
+        if bins is self.bins and self._row_pad and not self._multiproc:
+            out = out[:, :self.num_data]
+        return out
 
     def _select_drop(self) -> None:
         cfg = self.config
@@ -750,12 +761,15 @@ class DART(GBDT):
             self._boost_from_average()
         self._select_drop()
         self._drop_train_contrib = {}
-        for i in self._drop_index:
-            for k in range(self.num_class):
-                tree = self.models[self._model_index(i, k)]
-                contrib = self._tree_score(tree, self.bins)
-                self._drop_train_contrib[(i, k)] = contrib
-                self.scores = self.scores.at[k].add(-contrib)
+        pairs = [(i, k) for i in self._drop_index
+                 for k in range(self.num_class)]
+        if pairs:
+            contribs = self._trees_scores(
+                [self.models[self._model_index(i, k)] for i, k in pairs],
+                self.bins)
+            for t, (i, k) in enumerate(pairs):
+                self._drop_train_contrib[(i, k)] = contribs[t]
+                self.scores = self.scores.at[k].add(-contribs[t])
         finished = super().train_one_iter(grad, hess)
         if not finished:
             self.tree_weight.append(self._shrinkage)
@@ -777,16 +791,19 @@ class DART(GBDT):
             return
         factor = (k / (k + 1.0) if not cfg.xgboost_dart_mode
                   else k / (k + cfg.learning_rate))
+        pairs = [(i, c) for i in self._drop_index
+                 for c in range(self.num_class)]
+        dropped = [self.models[self._model_index(i, c)] for i, c in pairs]
+        # one batched traversal per valid set for ALL dropped trees
+        valid_contribs = [self._trees_scores(dropped, vs.bins)
+                          for vs in self.valid_sets]
+        for t, (i, c) in enumerate(pairs):
+            dropped[t].shrink(factor)
+            self.scores = self.scores.at[c].add(
+                self._drop_train_contrib[(i, c)] * factor)
+            for vs, contrib in zip(self.valid_sets, valid_contribs):
+                vs.scores = vs.scores.at[c].add(contrib[t] * (factor - 1.0))
         for i in self._drop_index:
-            for c in range(self.num_class):
-                tree = self.models[self._model_index(i, c)]
-                valid_contrib = [self._tree_score(tree, vs.bins)
-                                 for vs in self.valid_sets]
-                tree.shrink(factor)
-                self.scores = self.scores.at[c].add(
-                    self._drop_train_contrib[(i, c)] * factor)
-                for vs, contrib in zip(self.valid_sets, valid_contrib):
-                    vs.scores = vs.scores.at[c].add(contrib * (factor - 1.0))
             if not cfg.uniform_drop and i < len(self.tree_weight):
                 denom = (k + 1.0 if not cfg.xgboost_dart_mode
                          else k + cfg.learning_rate)

@@ -88,50 +88,75 @@ def tree_scores_binned(bins: jnp.ndarray, tree: Tree, used_feature_index,
     """Per-row output of one host tree evaluated on binned data [N].
 
     ``bin_mappers`` (per original feature) is required only for trees with
-    categorical nodes, to translate value bitsets into bin masks.
-    """
+    categorical nodes, to translate value bitsets into bin masks.  Thin
+    wrapper over the batched :func:`trees_scores_binned` (one packing
+    implementation to maintain)."""
+    return trees_scores_binned(bins, [tree], used_feature_index, feat_info,
+                               bin_mappers)[0]
+
+
+def trees_scores_binned(bins: jnp.ndarray, trees: List[Tree],
+                        used_feature_index, feat_info: jnp.ndarray,
+                        bin_mappers=None) -> jnp.ndarray:
+    """Per-row outputs of SEVERAL host trees on binned data -> [T, N].
+
+    All trees are padded to one shared pow2 node bucket and traversed by a
+    single vmapped jit call — DART's drop/normalize walks many trees per
+    iteration, and one batched call replaces T separate jit re-entries."""
     n = bins.shape[0]
-    nn = tree.num_leaves - 1
-    if nn <= 0:
-        val = tree.leaf_value[0] if len(tree.leaf_value) else 0.0
-        return jnp.full((n,), float(val), jnp.float32)
-    if not getattr(tree, "_binned_ok", False):
-        if bin_mappers is None:
-            log.fatal("bin_mappers required to predict a deserialized tree "
-                      "on binned data")
-        tree.ensure_binned(bin_mappers)
-    # pad node arrays to a power-of-two bucket: bounded set of jit signatures
+    if not trees:
+        return jnp.zeros((0, n), jnp.float32)
+    num_t = len(trees)
+    max_nn = max(max(t.num_leaves - 1, 1) for t in trees)
     p = 1
-    while p < nn:
+    while p < max_nn:
         p *= 2
-    def pad(a, fill=0):
-        return np.concatenate([np.asarray(a[:nn]),
-                               np.full(p - nn, fill, dtype=np.asarray(a).dtype)])
-    inner = np.asarray([used_feature_index[f] for f in tree.split_feature[:nn]],
-                       dtype=np.int32)
-    is_cat = (tree.decision_type[:nn] & 1) > 0
-    if tree.num_cat > 0 and is_cat.any():
-        if bin_mappers is None:
-            log.fatal("bin_mappers required to predict a categorical tree "
-                      "on binned data")
-        width = int(np.asarray(feat_info[:, 0]).max())
-        cat_mask = np.zeros((p, width), dtype=bool)
-        for i in np.nonzero(is_cat)[0]:
-            cat_mask[i] = tree.cat_bin_mask(
-                int(i), bin_mappers[tree.split_feature[i]], width)
-    else:
-        cat_mask = np.zeros((p, 1), dtype=bool)
-    leaf = predict_binned_leaf(
-        bins,
-        jnp.asarray(pad(inner)),
-        jnp.asarray(pad(tree.threshold_bin)),
-        jnp.asarray(pad((tree.decision_type[:nn] & 2) > 0, False)),
-        jnp.asarray(pad(tree.left_child, -1)),
-        jnp.asarray(pad(tree.right_child, -1)),
-        feat_info,
-        jnp.asarray(pad(is_cat, False)),
-        jnp.asarray(cat_mask))
-    return jnp.asarray(tree.leaf_value, jnp.float32)[leaf]
+    # BOTH axes pow2-bucketed so jit signatures stay bounded (DART drops a
+    # random tree count each iteration — padding trees are 0-valued stumps)
+    tp = 1
+    while tp < num_t:
+        tp *= 2
+    width = int(np.asarray(feat_info[:, 0]).max())
+    any_cat = any(t.num_cat > 0 for t in trees)
+    sf = np.zeros((tp, p), np.int32)
+    thr = np.zeros((tp, p), np.int32)
+    dl = np.zeros((tp, p), bool)
+    lc = np.full((tp, p), -1, np.int32)
+    rc = np.full((tp, p), -1, np.int32)
+    ic = np.zeros((tp, p), bool)
+    cm = np.zeros((tp, p, width if any_cat else 1), bool)
+    lv = np.zeros((tp, p + 1), np.float32)
+    for ti, tree in enumerate(trees):
+        nn = tree.num_leaves - 1
+        lv[ti, :tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+        if nn <= 0:
+            continue
+        if not getattr(tree, "_binned_ok", False):
+            if bin_mappers is None:
+                log.fatal("bin_mappers required to predict a deserialized "
+                          "tree on binned data")
+            tree.ensure_binned(bin_mappers)
+        sf[ti, :nn] = [used_feature_index[f]
+                       for f in tree.split_feature[:nn]]
+        thr[ti, :nn] = tree.threshold_bin[:nn]
+        dl[ti, :nn] = (tree.decision_type[:nn] & 2) > 0
+        lc[ti, :nn] = tree.left_child[:nn]
+        rc[ti, :nn] = tree.right_child[:nn]
+        cat = (tree.decision_type[:nn] & 1) > 0
+        ic[ti, :nn] = cat
+        if tree.num_cat > 0 and cat.any():
+            if bin_mappers is None:
+                log.fatal("bin_mappers required to predict a categorical "
+                          "tree on binned data")
+            for i in np.nonzero(cat)[0]:
+                cm[ti, i] = tree.cat_bin_mask(
+                    int(i), bin_mappers[tree.split_feature[i]], width)
+    leaf = jax.vmap(predict_binned_leaf,
+                    in_axes=(None, 0, 0, 0, 0, 0, None, 0, 0))(
+        bins, jnp.asarray(sf), jnp.asarray(thr), jnp.asarray(dl),
+        jnp.asarray(lc), jnp.asarray(rc), feat_info, jnp.asarray(ic),
+        jnp.asarray(cm))                                   # [Tp, N]
+    return jnp.take_along_axis(jnp.asarray(lv), leaf, axis=1)[:num_t]
 
 
 class Predictor:

@@ -23,7 +23,7 @@ from .utils import log
 
 K_CATEGORICAL_MASK = 1
 K_DEFAULT_LEFT_MASK = 2
-ZERO_RANGE = 1e-35
+ZERO_RANGE = 1e-20   # kZeroAsMissingValueRange (reference meta.h:22)
 
 MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
 

@@ -1,0 +1,163 @@
+"""PMML export (pmml/pmml.py analogue): the emitted document, evaluated by
+an independent PMML walker implemented here from the spec semantics
+(first-matching-child, predicates UNKNOWN on missing), must reproduce the
+booster's raw margins exactly."""
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.pmml import PMML_NS, model_to_pmml
+
+
+def _tag(el):
+    return el.tag.split("}")[-1]
+
+
+def _eval_predicate(el, row, fidx):
+    t = _tag(el)
+    if t == "True":
+        return True
+    if t == "SimplePredicate":
+        v = row[fidx[el.get("field")]]
+        if np.isnan(v):
+            return None                      # UNKNOWN
+        thr = float(el.get("value"))
+        return bool(v <= thr if el.get("operator") == "lessOrEqual"
+                    else v > thr)
+    if t == "SimpleSetPredicate":
+        v = row[fidx[el.get("field")]]
+        if np.isnan(v):
+            return None
+        vals = {int(x) for x in el.find(f"{{{PMML_NS}}}Array").text.split()}
+        return int(v) in vals
+    if t == "CompoundPredicate":
+        sub = [_eval_predicate(c, row, fidx) for c in el]
+        if el.get("booleanOperator") == "and":
+            if any(s is False for s in sub):
+                return False
+            return None if any(s is None for s in sub) else True
+        if any(s is True for s in sub):          # or
+            return True
+        return None if any(s is None for s in sub) else False
+    raise AssertionError(f"unhandled predicate {t}")
+
+
+def _eval_tree(node, row, fidx):
+    children = [c for c in node if _tag(c) == "Node"]
+    if not children:
+        return float(node.get("score"))
+    for c in children:
+        pred = next(p for p in c
+                    if _tag(p) in ("True", "SimplePredicate",
+                                   "SimpleSetPredicate",
+                                   "CompoundPredicate"))
+        if _eval_predicate(pred, row, fidx):
+            return _eval_tree(c, row, fidx)
+    raise AssertionError("no child matched (catch-all missing)")
+
+
+def _eval_pmml(doc, X):
+    root = ET.fromstring(doc)
+    ns = {"p": PMML_NS}
+    names = [f.get("name")
+             for f in root.find("p:DataDictionary", ns).findall(
+                 "p:DataField", ns)][:-1]
+    fidx = {n: i for i, n in enumerate(names)}
+    out = np.zeros(len(X))
+    for seg in root.find("p:MiningModel", ns).find(
+            "p:Segmentation", ns).findall("p:Segment", ns):
+        tm = seg.find("p:TreeModel", ns)
+        tree_root = tm.find("p:Node", ns)
+        for r in range(len(X)):
+            out[r] += _eval_tree(tree_root, X[r], fidx)
+    return out
+
+
+def test_pmml_matches_booster_raw(tmp_path):
+    rng = np.random.RandomState(8)
+    n, f = 2000, 6
+    X = rng.randn(n, f).astype(np.float64)
+    X[rng.rand(n, f) < 0.05] = np.nan       # exercise missing routing
+    w = rng.randn(f)
+    y = ((np.nan_to_num(X) @ w) > 0).astype(np.float32)
+    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=20,
+                  learning_rate=0.2, verbose=-1, use_missing=True)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    doc = model_to_pmml(bst.inner.save_model_to_string())
+    got = _eval_pmml(doc, X[:300])
+    want = bst.inner.predictor().predict_raw(X[:300])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
+
+
+def test_pmml_categorical(tmp_path):
+    rng = np.random.RandomState(9)
+    n = 2500
+    X = np.column_stack([rng.randint(0, 8, n).astype(np.float64),
+                         rng.randn(n)])
+    y = (np.isin(X[:, 0], [1, 3, 6]).astype(np.float64)
+         + 0.3 * X[:, 1] > 0.5).astype(np.float32)
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=20,
+                  verbose=-1)
+    bst = lgb.train(params, lgb.Dataset(X, label=y,
+                                        categorical_feature=[0]),
+                    num_boost_round=6)
+    doc = model_to_pmml(bst.inner.save_model_to_string())
+    got = _eval_pmml(doc, X[:300])
+    want = bst.inner.predictor().predict_raw(X[:300])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
+
+
+def test_pmml_cli(tmp_path, capsys):
+    from lightgbm_tpu.pmml import main
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 4)
+    y = (X.sum(1) > 0).astype(np.float32)
+    bst = lgb.train(dict(objective="regression", num_leaves=7, verbose=-1,
+                         min_data_in_leaf=10),
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    path = tmp_path / "m.txt"
+    bst.save_model(str(path))
+    assert main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("<?xml") and "MiningModel" in out
+
+def test_pmml_zero_as_missing():
+    """zero_as_missing: zeros and NaN route to the default side
+    (NumericalDecision, tree.h:231-251) — must survive PMML encoding."""
+    rng = np.random.RandomState(11)
+    n = 3000
+    X = rng.randn(n, 5)
+    X[rng.rand(n, 5) < 0.3] = 0.0
+    y = ((np.where(X == 0, -1.0, X) @ rng.randn(5)) > 0).astype(np.float32)
+    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=20,
+                  verbose=-1, zero_as_missing=True, use_missing=True)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+    doc = model_to_pmml(bst.inner.save_model_to_string())
+    Xt = X[:300].copy()
+    Xt[rng.rand(300, 5) < 0.1] = np.nan
+    got = _eval_pmml(doc, Xt)
+    want = bst.inner.predictor().predict_raw(Xt)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
+
+
+def test_pmml_multiclass_refused_rf_scaled():
+    rng = np.random.RandomState(12)
+    X = rng.randn(900, 5)
+    y3 = rng.randint(0, 3, 900).astype(np.float32)
+    m = lgb.train(dict(objective="multiclass", num_class=3, num_leaves=7,
+                       verbose=-1, min_data_in_leaf=10),
+                  lgb.Dataset(X, label=y3), num_boost_round=3)
+    with pytest.raises(ValueError, match="num_class"):
+        model_to_pmml(m.inner.save_model_to_string())
+
+    yb = (X.sum(1) > 0).astype(np.float32)
+    rf = lgb.train(dict(objective="binary", boosting="rf", num_leaves=7,
+                        verbose=-1, min_data_in_leaf=10,
+                        bagging_fraction=0.6, bagging_freq=1),
+                   lgb.Dataset(X, label=yb), num_boost_round=5)
+    doc = model_to_pmml(rf.inner.save_model_to_string())
+    got = _eval_pmml(doc, X[:200])
+    want = rf.inner.predictor().predict_raw(X[:200])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)
