@@ -1,0 +1,185 @@
+# R binding for lightgbm_tpu.
+#
+# Architecture: a deliberate thin FILE-based binding over the
+# `lightgbm-tpu` CLI (the same engine the Python package drives).  The
+# reference R-package binds its C API in-process; here training runs on a
+# TPU-backed Python runtime, so the stable exchange surface is the
+# reference's own text formats — data files, `key=value` config files and
+# model files — which this package reads and writes with base R only.
+# Models produced here load in the Python package, the reference CLI and
+# the reference R package unchanged, and vice versa.
+
+.lgbtpu_bin <- function() {
+  bin <- Sys.getenv("LIGHTGBM_TPU_BIN", "lightgbm-tpu")
+  if (Sys.which(bin) == "" && !file.exists(bin)) {
+    stop("lightgbm-tpu CLI not found; install the python package ",
+         "(pip install lightgbm_tpu) or set LIGHTGBM_TPU_BIN")
+  }
+  bin
+}
+
+.lgbtpu_run <- function(args) {
+  bin <- .lgbtpu_bin()
+  status <- system2(bin, args = shQuote(args), stdout = TRUE, stderr = TRUE)
+  code <- attr(status, "status")
+  if (!is.null(code) && code != 0) {
+    stop("lightgbm-tpu failed (exit ", code, "):\n",
+         paste(utils::tail(status, 20), collapse = "\n"))
+  }
+  invisible(status)
+}
+
+.lgbtpu_write_data <- function(data, label, path) {
+  data <- as.matrix(data)
+  if (!is.numeric(data)) {
+    stop("feature data must be numeric; encode factors/characters first ",
+         "(e.g. with model.matrix or as.integer on factor levels)")
+  }
+  storage.mode(data) <- "double"
+  if (is.null(label)) {
+    label <- rep(0, nrow(data))
+  } else if (is.factor(label) || is.character(label)) {
+    stop("label must be numeric (0-based classes for classification); ",
+         "got ", class(label)[1],
+         " — convert explicitly, e.g. as.integer(factor(y)) - 1")
+  }
+  out <- cbind(as.numeric(label), data)
+  # reference TSV convention: label first, no header, NA -> "nan"
+  utils::write.table(out, file = path, sep = "\t", na = "nan",
+                     row.names = FALSE, col.names = FALSE)
+  invisible(path)
+}
+
+# args owned by the binding itself; user params may not override them
+.lgbtpu_reserved <- c("task", "data", "output_model", "input_model",
+                      "output_result", "valid_data", "num_iterations")
+
+.lgbtpu_params <- function(params) {
+  if (length(params) == 0) return(character(0))
+  keys <- names(params)
+  if (is.null(keys) || any(!nzchar(keys))) {
+    stop("params must be a fully named list, e.g. ",
+         'list(objective = "binary", num_leaves = 31)')
+  }
+  bad <- intersect(keys, .lgbtpu_reserved)
+  if (length(bad)) {
+    stop("params may not override reserved arguments: ",
+         paste(bad, collapse = ", "),
+         " (use the function arguments / lgb.save instead)")
+  }
+  vapply(keys,
+         function(k) paste0(k, "=", paste(params[[k]], collapse = ",")),
+         character(1))
+}
+
+#' Train a gradient boosted model.
+#'
+#' @param data numeric matrix or data.frame of features.
+#' @param label numeric response vector (0-based classes for
+#'   classification objectives).
+#' @param params named list of LightGBM-style parameters
+#'   (objective, num_leaves, learning_rate, ...).
+#' @param nrounds number of boosting iterations.
+#' @param valids optional named list of list(data=, label=) validation sets.
+#' @return an object of class `lgbtpu.Booster`.
+lgb.train <- function(data, label, params = list(), nrounds = 100L,
+                      valids = NULL) {
+  work <- tempfile("lgbtpu_")
+  dir.create(work)
+  on.exit(unlink(work, recursive = TRUE), add = TRUE)
+  train_file <- file.path(work, "train.tsv")
+  .lgbtpu_write_data(data, label, train_file)
+  model_file <- file.path(work, "model.txt")
+  args <- c("task=train",
+            paste0("data=", train_file),
+            paste0("output_model=", model_file),
+            paste0("num_iterations=", as.integer(nrounds)),
+            .lgbtpu_params(params))
+  if (!is.null(valids)) {
+    vfiles <- character(0)
+    for (i in seq_along(valids)) {
+      vf <- file.path(work, paste0("valid_", i, ".tsv"))
+      .lgbtpu_write_data(valids[[i]]$data, valids[[i]]$label, vf)
+      vfiles <- c(vfiles, vf)
+    }
+    args <- c(args, paste0("valid_data=", paste(vfiles, collapse = ",")))
+  }
+  log <- .lgbtpu_run(args)
+  structure(
+    list(model_string = readLines(model_file), train_log = log),
+    class = "lgbtpu.Booster")
+}
+
+#' Predict with a trained model.
+#'
+#' @param model an `lgbtpu.Booster` (or result of [lgb.load]).
+#' @param data numeric matrix or data.frame of features.
+#' @param raw_score return raw margins instead of transformed output.
+#' @return numeric vector (or matrix for multiclass) of predictions.
+lgb.predict <- function(model, data, raw_score = FALSE) {
+  work <- tempfile("lgbtpu_pred_")
+  dir.create(work)
+  on.exit(unlink(work, recursive = TRUE), add = TRUE)
+  data_file <- file.path(work, "pred.tsv")
+  .lgbtpu_write_data(data, NULL, data_file)
+  model_file <- file.path(work, "model.txt")
+  writeLines(model$model_string, model_file)
+  out_file <- file.path(work, "pred_out.txt")
+  .lgbtpu_run(c("task=predict",
+                paste0("data=", data_file),
+                paste0("input_model=", model_file),
+                paste0("output_result=", out_file),
+                paste0("predict_raw_score=",
+                       if (raw_score) "true" else "false")))
+  out <- utils::read.table(out_file, header = FALSE)
+  if (ncol(out) == 1) out[[1]] else as.matrix(out)
+}
+
+#' @export
+predict.lgbtpu.Booster <- function(object, newdata, ...) {
+  lgb.predict(object, newdata, ...)
+}
+
+#' Save a model in the reference text format.
+lgb.save <- function(model, filename) {
+  writeLines(model$model_string, filename)
+  invisible(filename)
+}
+
+#' Load a model saved by this package, the Python package, or the
+#' reference implementation.
+lgb.load <- function(filename) {
+  structure(list(model_string = readLines(filename), train_log = NULL),
+            class = "lgbtpu.Booster")
+}
+
+#' Split-count feature importance parsed from the model file's trailer.
+lgb.importance <- function(model) {
+  empty <- data.frame(Feature = character(0), Importance = numeric(0),
+                      stringsAsFactors = FALSE)
+  lines <- model$model_string
+  start <- which(lines == "feature importances:")
+  if (length(start) == 0 || start[1] >= length(lines)) return(empty)
+  body <- lines[seq(start[1] + 1, length(lines))]
+  # reference model files append a "parameters:" block after the
+  # importances — stop at the first non "name=count" line
+  kv_like <- grepl("^[^=]+=[0-9.eE+-]+$", body)
+  if (any(!kv_like)) {
+    end <- which(!kv_like)[1] - 1L
+    if (end < 1L) return(empty)
+    body <- body[seq_len(end)]
+  }
+  body <- body[nzchar(body)]
+  if (length(body) == 0) return(empty)
+  kv <- strsplit(body, "=", fixed = TRUE)
+  data.frame(Feature = vapply(kv, `[`, character(1), 1),
+             Importance = as.numeric(vapply(kv, `[`, character(1), 2)),
+             stringsAsFactors = FALSE)
+}
+
+#' @export
+print.lgbtpu.Booster <- function(x, ...) {
+  n_trees <- sum(startsWith(x$model_string, "Tree="))
+  cat("lightgbm_tpu booster:", n_trees, "trees\n")
+  invisible(x)
+}
