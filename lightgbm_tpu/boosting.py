@@ -118,6 +118,9 @@ class GBDT:
             min_gain_to_split=cfg.min_gain_to_split,
             max_bin=train.max_num_bin(),
             hist_method=("pallas" if cfg.use_pallas and _on_tpu() else "einsum"),
+            feat_tile=cfg.pallas_feat_tile,
+            row_tile=cfg.pallas_row_tile,
+            bucket_min_log2=cfg.pallas_bucket_min_log2,
             has_categorical=bool(np.asarray(fm["is_categorical"]).any()),
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_group=cfg.max_cat_group,

@@ -221,6 +221,9 @@ class Config:
     gpu_use_dp: bool = False
     hist_dtype: str = "float32"    # accumulator dtype for histograms
     use_pallas: bool = True        # Pallas hist kernel on TPU; einsum otherwise
+    pallas_feat_tile: int = 8      # kernel grid: features per block
+    pallas_row_tile: int = 512     # kernel grid: rows per block
+    pallas_bucket_min_log2: int = 10   # smallest pow2 gather bucket
 
     # file-task fields (CLI)
     data: str = ""
@@ -322,6 +325,17 @@ def check_param_conflicts(cfg: Config) -> None:
             log.fatal("Random forest needs bagging (bagging_freq > 0 and 0 < bagging_fraction < 1)")
     if cfg.max_bin > 65535:
         log.fatal("max_bin too large (must fit uint16)")
+    # Pallas grid knobs: catch bad values here with the real cause instead
+    # of an opaque Mosaic layout error at trace/compile time
+    if cfg.pallas_row_tile <= 0 or cfg.pallas_row_tile % 128 != 0:
+        log.fatal("pallas_row_tile must be a positive multiple of 128 "
+                  "(the TPU lane width); got %d", cfg.pallas_row_tile)
+    if cfg.pallas_feat_tile <= 0:
+        log.fatal("pallas_feat_tile must be positive; got %d",
+                  cfg.pallas_feat_tile)
+    if cfg.pallas_bucket_min_log2 < 0 or cfg.pallas_bucket_min_log2 > 26:
+        log.fatal("pallas_bucket_min_log2 must be in [0, 26]; got %d",
+                  cfg.pallas_bucket_min_log2)
 
 
 def parse_config_file(path: str) -> Dict[str, str]:

@@ -53,6 +53,8 @@ class GrowerConfig(NamedTuple):
     min_gain_to_split: float = 0.0
     max_bin: int = 256               # B: histogram width (max over features)
     hist_method: str = "auto"        # pallas | einsum | auto
+    feat_tile: int = 8               # Pallas grid: features per block
+    row_tile: int = 512              # Pallas grid: rows per block
     bucket_min_log2: int = 10        # smallest pow2 gather-buffer bucket
     has_categorical: bool = False    # static: enables the categorical path
     max_cat_threshold: int = 256
@@ -304,7 +306,9 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             rows = jnp.take(hbins_pad, idx, axis=0)
             return subset_histogram(rows, gw_pad[idx], hw_pad[idx],
                                     cw_pad[idx], cfg.max_bin,
-                                    method=cfg.hist_method)
+                                    method=cfg.hist_method,
+                                    feat_tile=cfg.feat_tile,
+                                    row_tile=cfg.row_tile)
 
         def bucket_branch(k):
             def branch(args):
@@ -330,7 +334,9 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
 
         hist_root = strategy.reduce_hist(
             subset_histogram(hbins, gw, hw, cw, cfg.max_bin,
-                             method=cfg.hist_method))
+                             method=cfg.hist_method,
+                             feat_tile=cfg.feat_tile,
+                             row_tile=cfg.row_tile))
         res_root = find(hist_root, root_g, root_h, root_c)
         res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
 

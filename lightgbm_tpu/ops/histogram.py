@@ -70,15 +70,22 @@ def subset_histogram_einsum(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
 def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                      c: jnp.ndarray, num_bins: int,
-                     method: str = "auto") -> jnp.ndarray:
-    """Dispatch subset histogram: rows [M, F] int, g/h/c [M] -> [F, B, 3]."""
+                     method: str = "auto", feat_tile: int = 8,
+                     row_tile: int = 512) -> jnp.ndarray:
+    """Dispatch subset histogram: rows [M, F] int, g/h/c [M] -> [F, B, 3].
+
+    ``feat_tile``/``row_tile`` shape the Pallas kernel's grid — the analogue
+    of the reference GPU learner's workgroup tuning
+    (gpu_tree_learner.cpp:103-121)."""
     if method == "auto":
         method = ("pallas"
                   if any(d.platform == "tpu" for d in jax.devices())
                   else "einsum")
     if method == "pallas":
         from .pallas_hist import subset_histogram_pallas
-        return subset_histogram_pallas(rows, g, h, c, num_bins)
+        return subset_histogram_pallas(rows, g, h, c, num_bins,
+                                       feat_tile=feat_tile,
+                                       row_tile=row_tile)
     if method == "einsum":
         return subset_histogram_einsum(rows, g, h, c, num_bins)
     raise ValueError(f"unknown histogram method {method!r}")

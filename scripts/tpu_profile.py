@@ -1,0 +1,102 @@
+"""Single-chip perf sweep + phase breakdown (run on the TPU host).
+
+Produces the evidence behind docs/PERF.md: per-phase timing of the bench
+workload, a tile-size sweep for the Pallas histogram kernel (the analogue of
+the reference's GPU workgroup tuning, gpu_tree_learner.cpp:103-121), and an
+optional jax.profiler trace.
+
+    python scripts/tpu_profile.py [rows] [trace_dir]
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def make_data(n, f=28, seed=42):
+    sys.path.insert(0, ".")
+    from bench import make_data as bench_make
+    return bench_make(n, f)
+
+
+def train_tps(X, y, n_timed=10, **extra_params):
+    import jax
+    from lightgbm_tpu.config import config_from_params
+    from lightgbm_tpu.data.dataset import construct
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.utils import log as _log
+    _log.set_verbosity(-1)
+
+    params = dict(objective="binary", num_leaves=255, max_bin=255,
+                  min_data_in_leaf=1, min_sum_hessian_in_leaf=100,
+                  learning_rate=0.1, verbose=-1, use_pallas=True)
+    params.update(extra_params)
+    cfg = config_from_params(params)
+    ds = construct(X, cfg, label=y)
+    bst = create_boosting(cfg, ds, create_objective(cfg))
+    t0 = time.perf_counter()
+    bst.train_one_iter()
+    jax.block_until_ready(bst.scores)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n_timed):
+        bst.train_one_iter()
+    jax.block_until_ready(bst.scores)
+    dt = time.perf_counter() - t0
+    return n_timed / dt, compile_s, bst
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    trace_dir = sys.argv[2] if len(sys.argv) > 2 else None
+    import jax
+    print("platform:", jax.devices()[0].platform, flush=True)
+    X, y = make_data(rows)
+
+    # --- baseline config + phase breakdown -----------------------------------
+    tps, comp, bst = train_tps(X, y)
+    print(f"\nbaseline (ft=8, rt=512, bmin=10): {tps:.3f} trees/s "
+          f"(compile {comp:.0f}s)")
+    print("phases:", bst.timers.report(), flush=True)
+
+    # --- MFU estimate for the histogram matmuls ------------------------------
+    # per tree ~ sum over splits of smaller-child rows ~ N*log2(L)/2;
+    # kernel FLOPs = 2 * 6ch * M * Fpad * Bpad per histogram
+    n, l = rows, 255
+    m_total = n * np.log2(l) / 2
+    flops_tree = 2 * 6 * m_total * 32 * 256
+    peak = 394e12  # v5e bf16 peak FLOP/s
+    print(f"hist matmul FLOPs/tree ~{flops_tree/1e9:.1f} GF -> "
+          f"MFU at measured rate: {flops_tree * tps / peak * 100:.2f}%")
+
+    # --- tile sweep ----------------------------------------------------------
+    print("\ntile sweep (trees/s):")
+    for ft, rt in [(4, 512), (8, 256), (8, 512), (8, 1024), (16, 512),
+                   (16, 1024), (32, 512)]:
+        try:
+            tps_i, comp_i, _ = train_tps(X, y, n_timed=5,
+                                         pallas_feat_tile=ft,
+                                         pallas_row_tile=rt)
+            print(f"  feat_tile={ft:3d} row_tile={rt:5d}: {tps_i:7.3f} "
+                  f"(compile {comp_i:.0f}s)", flush=True)
+        except Exception as e:
+            print(f"  feat_tile={ft:3d} row_tile={rt:5d}: FAILED "
+                  f"{str(e)[:120]}", flush=True)
+
+    # --- gather bucket sweep -------------------------------------------------
+    print("\nbucket_min_log2 sweep (trees/s):")
+    for bmin in (8, 10, 12, 14):
+        tps_i, comp_i, _ = train_tps(X, y, n_timed=5,
+                                     pallas_bucket_min_log2=bmin)
+        print(f"  bmin={bmin:2d}: {tps_i:7.3f} (compile {comp_i:.0f}s)",
+              flush=True)
+
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            tps_i, _, _ = train_tps(X, y, n_timed=2)
+        print("trace written to", trace_dir)
+
+
+if __name__ == "__main__":
+    main()
