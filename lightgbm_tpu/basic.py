@@ -203,6 +203,87 @@ class Dataset:
         qb = self.constructed.metadata.query_boundaries
         return None if qb is None else np.diff(qb)
 
+    def get_init_score(self):
+        return self.constructed.metadata.init_score
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        """Generic metadata setter (reference Dataset.set_field)."""
+        setters = {"label": self.set_label, "weight": self.set_weight,
+                   "group": self.set_group, "query": self.set_group,
+                   "init_score": self.set_init_score}
+        if field_name not in setters:
+            raise ValueError(f"Unknown field {field_name!r}")
+        return setters[field_name](data)
+
+    def get_field(self, field_name: str):
+        getters = {"label": self.get_label, "weight": self.get_weight,
+                   "group": self.get_group, "query": self.get_group,
+                   "init_score": self.get_init_score}
+        if field_name not in getters:
+            raise ValueError(f"Unknown field {field_name!r}")
+        return getters[field_name]()
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        if feature_name == "auto":     # reference sentinel: keep as-is
+            return self
+        self.feature_name = list(feature_name)
+        if self._constructed is not None:
+            self._constructed.feature_names = list(feature_name)
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if self._constructed is not None and \
+                categorical_feature != self.categorical_feature:
+            log.warning("categorical_feature change after construction "
+                        "requires reconstructing the Dataset")
+            self._constructed = None
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        if self._constructed is not None and reference is not self.reference:
+            self._constructed = None   # rebin against the new reference
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """Set of datasets reachable through reference links."""
+        chain, cur = [], self
+        while cur is not None and len(chain) < ref_limit:
+            chain.append(cur)
+            cur = cur.reference
+        return set(chain)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row-subset Dataset sharing this dataset's bin mappers
+        (reference Dataset.subset; requires raw data retained in memory)."""
+        self.construct()
+        if self.raw is None or isinstance(self.raw, (str, os.PathLike)):
+            log.fatal("Cannot subset: raw data not in memory (construct "
+                      "with free_raw_data=False from an in-memory matrix)")
+        raw = self.raw
+        idx = np.asarray(used_indices, dtype=np.int64)
+        label = self.get_label()
+        w = self.get_weight()
+        init = self.get_init_score()
+        group = self.get_group()
+        sub_group = None
+        if group is not None:
+            # per-row query ids -> counts of SELECTED rows per query, empty
+            # queries dropped (row subset of grouped data keeps group
+            # structure like the reference's index-based subset)
+            qid = np.repeat(np.arange(len(group)), group.astype(np.int64))
+            counts = np.bincount(qid[idx], minlength=len(group))
+            sub_group = counts[counts > 0]
+        return Dataset(raw[idx],
+                       label=None if label is None else label[idx],
+                       weight=None if w is None else np.asarray(w)[idx],
+                       group=sub_group,
+                       init_score=None if init is None
+                       else np.asarray(init)[idx],
+                       reference=self,
+                       params=dict(params or self.params))
+
     def num_data(self) -> int:
         return self.constructed.num_data
 
@@ -298,6 +379,61 @@ class Booster:
 
     def current_iteration(self) -> int:
         return self.inner.current_iteration()
+
+    def attr(self, key: str):
+        """Free-form model attribute (reference Booster.attr)."""
+        return getattr(self, "_attr", {}).get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        store = getattr(self, "_attr", {})
+        for k, v in kwargs.items():
+            if v is None:
+                store.pop(k, None)
+            else:
+                store[k] = str(v)
+        self._attr = store
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """Release the training/validation data (binned matrices, scores,
+        bag subsets) — predict/save/dump still work; further training and
+        eval do not (reference Booster.free_dataset contract)."""
+        self._train_dataset = None
+        self._valid_datasets = []
+        inner = self.inner
+        inner.train_set = None
+        inner.valid_sets = []
+        inner.bins = None
+        inner.scores = None
+        inner._subset_state = None
+        inner._local_bins_cache = None
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Raw leaf output; tree_id indexes the stored model list directly,
+        INCLUDING the boost-from-average init tree when present — the
+        reference pushes that init tree into models_ too
+        (gbdt.cpp:467-483), so the numbering matches."""
+        return float(self.inner.models[tree_id].leaf_value[leaf_id])
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        """Evaluate the current model on an arbitrary dataset
+        (reference Booster.eval)."""
+        datasets = getattr(self, "_valid_datasets", [])
+        for i, vs in enumerate(self.inner.valid_sets):
+            if i < len(datasets) and datasets[i] is data:
+                break
+        else:
+            self.add_valid(data, name)   # not attached: score from scratch
+            vs = self.inner.valid_sets[-1]
+        res = [(name, m, v, h) for (_, m, v, h)
+               in self.inner._eval(vs.name, vs.metrics,
+                                   np.asarray(vs.scores, np.float64))]
+        return self._add_feval(res, name, feval, vs.scores, data)
 
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         canon = canonicalize_params(params)

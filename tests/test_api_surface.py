@@ -1,0 +1,57 @@
+"""Reference python-package API surface parity: the Dataset/Booster
+methods a switching user reaches for (set/get_field, subset, ref chain,
+attrs, eval-on-arbitrary-data, leaf output) — python-package/lightgbm/basic.py
+analogues."""
+
+import numpy as np
+import lightgbm_tpu as lgb
+
+
+def test_api_surface():
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 5).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    d = lgb.Dataset(X, label=y, free_raw_data=False)
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=10, verbose=-1)
+    bst = lgb.train(params, d, num_boost_round=3)
+    # Dataset surface
+    assert d.get_field("label") is not None
+    d2 = d.subset(np.arange(0, 300))
+    assert d2.num_data() == 300
+    assert d in d.get_ref_chain()
+    d.set_feature_name([f"f{i}" for i in range(5)])
+    # Booster surface
+    bst.set_attr(best="3", junk="x").set_attr(junk=None)
+    assert bst.attr("best") == "3" and bst.attr("junk") is None
+    assert bst.num_trees() >= 3
+    lv = bst.get_leaf_output(1, 0)
+    assert isinstance(lv, float)
+    dv = lgb.Dataset(X[:200], label=y[:200], reference=d)
+    res = bst.eval(dv, "holdout")
+    assert res and res[0][0] == "holdout", res
+    bst.set_train_data_name("train").free_dataset()
+    print("surface OK:", [(r[1], round(r[2], 4)) for r in res])
+
+
+def test_subset_grouped_and_free_dataset():
+    rng = np.random.RandomState(2)
+    n = 400
+    X = rng.randn(n, 4).astype(np.float32)
+    y = rng.randint(0, 3, n).astype(np.float32)
+    group = np.full(20, 20)                       # 20 queries of 20 docs
+    d = lgb.Dataset(X, label=y, group=group, free_raw_data=False,
+                    params=dict(objective="lambdarank", verbose=-1))
+    idx = np.arange(0, n, 2)                      # half of every query
+    sub = d.subset(idx)
+    g = sub.construct().get_group()
+    assert g.sum() == len(idx) and len(g) == 20
+
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=10,
+                  verbose=-1)
+    yb = (X.sum(1) > 0).astype(np.float32)
+    bst = lgb.train(params, lgb.Dataset(X, label=yb), num_boost_round=3)
+    p1 = bst.predict(X[:50])
+    bst.free_dataset()
+    assert bst.inner.bins is None and bst.inner.train_set is None
+    np.testing.assert_array_equal(bst.predict(X[:50]), p1)
+    assert "Tree=" in bst.model_to_string()
