@@ -1,0 +1,51 @@
+"""Regenerate docs/Parameters.md from the Config dataclass + alias table."""
+import collections
+import dataclasses
+import os
+import re
+
+from lightgbm_tpu import config as C
+
+HEADER = """# Parameters
+
+Every parameter the framework accepts, generated from the canonical
+`lightgbm_tpu.config.Config` dataclass (the analogue of the reference's
+`docs/Parameters.md` / `include/LightGBM/config.h`).  Aliases are accepted
+everywhere parameters are (python `params` dicts, CLI `key=value` args,
+config files); unknown parameters are rejected.
+
+Regenerate with `python scripts/gen_parameters_doc.py`.
+
+| Parameter | Default | Aliases | Notes |
+|---|---|---|---|
+"""
+
+
+def main():
+    alias_map = collections.defaultdict(list)
+    for a, canon in C.PARAM_ALIASES.items():
+        alias_map[canon].append(a)
+
+    cfg_src = os.path.join(os.path.dirname(C.__file__), "config.py")
+    comments = {}
+    for line in open(cfg_src):
+        m = re.match(r'\s*(\w+):\s*[\w\[\]\., "\'=]+#\s*(.+)$', line)
+        if m:
+            comments[m.group(1)] = m.group(2).strip()
+
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "Parameters.md")
+    with open(out_path, "w") as out:
+        out.write(HEADER)
+        for f in dataclasses.fields(C.Config):
+            default = f.default if f.default is not dataclasses.MISSING \
+                else (f.default_factory()
+                      if f.default_factory is not dataclasses.MISSING else "")
+            aliases = ", ".join(sorted(alias_map.get(f.name, [])))
+            desc = comments.get(f.name, "").replace("|", "\\|")
+            out.write(f"| `{f.name}` | `{default!r}` | {aliases} | {desc} |\n")
+    print("wrote", out_path)
+
+
+if __name__ == "__main__":
+    main()
