@@ -172,10 +172,10 @@ class GBDT:
             cfg.mesh_devices != 1 and n_devices > 1)
         from .parallel.sync import process_count
         if process_count() > 1 and not use_dist:
-            log.fatal("num_machines > 1 requires tree_learner=data or voting "
-                      "over >1 devices (each process holds a row partition; "
-                      "a serial learner would silently train per-partition "
-                      "models)")
+            log.fatal("num_machines > 1 requires tree_learner=data, voting "
+                      "(per-process row partitions) or feature (full data "
+                      "on every process) over >1 devices; a serial learner "
+                      "would silently train per-partition models")
         # the bagged-subset optimization (gbdt.cpp:323-382 is_use_subset_)
         # gathers rows into a compact matrix — serial learner only for now
         self._can_subset = not use_dist
@@ -195,11 +195,14 @@ class GBDT:
         shards = int(mesh.devices.size)
         n = self.num_data
         self._multiproc = jax.process_count() > 1
-        if self._multiproc and cfg.tree_learner not in ("data", "voting"):
-            log.fatal("multi-process training supports tree_learner=data or "
-                      "voting (feature-parallel shards columns, which does "
-                      "not match per-machine row partitions)")
-        if self._multiproc:
+        self._multiproc_replicated = False
+        if self._multiproc and cfg.tree_learner == "feature":
+            # feature-parallel multi-host: EVERY machine holds the full data
+            # (the reference's feature-parallel contract,
+            # docs/Parallel-Learning-Guide.md) — arrays are replicated over
+            # the global mesh and each device scans its own column slice
+            self._multiproc_replicated = True
+        elif self._multiproc:
             from jax.experimental import multihost_utils
             from jax.sharding import NamedSharding, PartitionSpec as P
             # every process contributes its local partition; per-device row
@@ -217,10 +220,6 @@ class GBDT:
             self.bins = jax.make_array_from_process_local_data(
                 NamedSharding(mesh, P(axis, None)), binned,
                 (self._global_rows, binned.shape[1]))
-            # replicated inputs go in as host arrays (jit replicates them);
-            # device-committed single-process arrays would be rejected
-            self.meta = FeatureMeta(*[None if f is None else np.asarray(f)
-                                      for f in self.meta])
             log.info("Multi-process training: %d processes, %d local rows, "
                      "%d global (padded) rows", jax.process_count(), n,
                      self._global_rows)
@@ -228,24 +227,59 @@ class GBDT:
             self._row_pad = pad_rows(n, shards)
             self.bins = (jnp.pad(self.bins, ((0, self._row_pad), (0, 0)))
                          if self._row_pad else jnp.asarray(self.bins))
-        else:
+        if cfg.tree_learner == "feature":
             bundled = self.meta.col is not None
-            ncols = int(self.bins.shape[1])
+            ncols = int(np.shape(self.bins)[1])
             col_pad = pad_features(ncols, shards)
             # pad PHYSICAL columns; bundled logical meta stays intact
             # (no logical feature maps to a pad column)
-            self.bins = (jnp.pad(self.bins, ((0, 0), (0, col_pad)))
-                         if col_pad else jnp.asarray(self.bins))
+            binned = np.asarray(self.bins)
+            if col_pad:
+                binned = np.pad(binned, ((0, 0), (0, col_pad)))
             if not bundled:
                 self._feat_pad = col_pad
                 if col_pad:
-                    pad1 = lambda a, v: jnp.pad(a, (0, self._feat_pad),
-                                                constant_values=v)
+                    pad1 = lambda a, v: np.pad(np.asarray(a),
+                                               (0, self._feat_pad),
+                                               constant_values=v)
                     self.meta = FeatureMeta(
                         num_bin=pad1(self.meta.num_bin, 1),
                         missing_type=pad1(self.meta.missing_type, 0),
                         default_bin=pad1(self.meta.default_bin, 0),
                         is_categorical=pad1(self.meta.is_categorical, False))
+            if self._multiproc_replicated:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from .parallel.sync import allgather_object
+                import zlib
+                # the replication CONTRACT must hold: every process feeds the
+                # same full matrix (a user migrating from tree_learner=data
+                # may still be feeding per-process partitions — reject that
+                # loudly instead of training on silently inconsistent data)
+                sig = (binned.shape, zlib.crc32(binned.tobytes()))
+                sigs = allgather_object(sig)
+                if any(s != sig for s in sigs):
+                    log.fatal("feature-parallel multi-process training "
+                              "requires the FULL identical dataset on every "
+                              "process (got differing data signatures %s); "
+                              "per-process row partitions need "
+                              "tree_learner=data or voting", sigs)
+                # identical full data on every process -> one replicated
+                # global array; per-row vectors ride the same sharding
+                repl = NamedSharding(mesh, P())
+                self.bins = jax.make_array_from_process_local_data(
+                    repl, binned, binned.shape)
+                self._row_sharding = repl
+                self._global_rows = n
+                log.info("Multi-process feature-parallel: %d processes, "
+                         "full data replicated (%d rows)",
+                         jax.process_count(), n)
+            else:
+                self.bins = jnp.asarray(binned)
+        if self._multiproc:
+            # replicated inputs go in as host arrays (jit replicates them);
+            # device-committed single-process arrays would be rejected
+            self.meta = FeatureMeta(*[None if f is None else np.asarray(f)
+                                      for f in self.meta])
         log.info("Using %s-parallel tree learner over %d devices",
                  cfg.tree_learner, shards)
         self.grow = make_distributed_grower(self.grower_cfg, mesh,
@@ -482,8 +516,14 @@ class GBDT:
             if self._row_pad else jnp.asarray(x, jnp.float32)
         imap = self._row_sharding.addressable_devices_indices_map(
             (self._global_rows,))
-        start0 = min(s[0].start for s in imap.values())
-        shards = [jax.device_put(xl[s[0].start - start0:s[0].stop - start0], d)
+        # works for both shardings: row-sharded slices are rebased to this
+        # process's block; replicated slices are the full range on every
+        # device (start 0) — either way, device-to-device placement only
+        start0 = min(s[0].start or 0 for s in imap.values())
+        shards = [jax.device_put(
+            xl[(s[0].start or 0) - start0:
+               (s[0].stop if s[0].stop is not None else self._global_rows)
+               - start0], d)
                   for d, s in imap.items()]
         return jax.make_array_from_single_device_arrays(
             (self._global_rows,), self._row_sharding, shards)
@@ -492,6 +532,8 @@ class GBDT:
         """The grower's row-sharded output -> this process's local rows."""
         if not self._multiproc:
             return row_leaf[:self.num_data] if self._row_pad else row_leaf
+        if self._multiproc_replicated:   # fully addressable: read directly
+            return jnp.asarray(np.asarray(row_leaf)[:self.num_data])
         parts = sorted(row_leaf.addressable_shards,
                        key=lambda s: s.index[0].start or 0)
         local = np.concatenate([np.asarray(p.data) for p in parts])

@@ -59,6 +59,38 @@ n, f = 3000, 8
 X = (rng.randint(0, 24, size=(n, f)) / 4.0).astype(np.float32)
 w = rng.randn(f)
 y = ((X @ w + 2.0 * rng.randn(n)) > np.median(X @ w)).astype(np.float32)
+
+if os.environ.get("TEST_MODE") == "feature_bad":
+    # contract violation: per-process partitions fed to feature-parallel
+    # must be rejected loudly (differing data signatures)
+    from lightgbm_tpu.parallel.mesh import init_distributed_from_config
+    lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
+    params = dict(objective="binary", num_leaves=15, verbose=-1,
+                  tree_learner="feature", num_machines=2,
+                  machine_list_file=mlist)
+    try:
+        lgb.train(params, lgb.Dataset(X[lo:hi], label=y[lo:hi]),
+                  num_boost_round=2)
+    except Exception as e:
+        assert "FULL identical dataset" in str(e), e
+        print("WORKER_OK", rank)
+        sys.exit(0)
+    print("NO_ERROR: contract violation was accepted")
+    sys.exit(1)
+
+if os.environ.get("TEST_MODE") == "feature":
+    # feature-parallel multi-host: every machine holds the FULL data
+    # (reference feature-parallel contract); identical models required
+    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=10,
+                  learning_rate=0.2, verbose=-1, tree_learner="feature",
+                  num_machines=2, machine_list_file=mlist)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    bst.save_model(out)
+    import jax
+    assert jax.process_count() == 2
+    print("WORKER_OK", rank)
+    sys.exit(0)
+
 # this process's row partition (pre-partitioned parallel learning)
 lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
 
@@ -82,14 +114,23 @@ print("WORKER_OK", rank)
 """
 
 
-@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
-                    reason="multiprocess test disabled")
-def test_two_process_data_parallel(tmp_path):
+def _make_grid_problem():
+    """Shared dataset: discrete grid so per-process mappers are identical."""
+    rng = np.random.RandomState(7)
+    n, f = 3000, 8
+    X = (rng.randint(0, 24, size=(n, f)) / 4.0).astype(np.float32)
+    w = rng.randn(f)
+    y = ((X @ w + 2.0 * rng.randn(n)) > np.median(X @ w)).astype(np.float32)
+    return X, y
+
+
+def _run_workers(tmp_path, mode=None):
+    """Spawn the 2-process worker pair; returns per-rank stdout after
+    asserting both exited 0 with WORKER_OK."""
     port = _free_port()
     mlist = tmp_path / "mlist.txt"
     # reference machine-list format: "ip port" per line
     mlist.write_text(f"127.0.0.1 {port}\n127.0.0.1 {port + 1}\n")
-
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
     procs = []
@@ -98,6 +139,8 @@ def test_two_process_data_parallel(tmp_path):
         env.update(LGBM_TPU_RANK=str(rank), TEST_MLIST=str(mlist),
                    TEST_OUT=str(tmp_path / f"model_{rank}.txt"),
                    PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+        if mode is not None:
+            env["TEST_MODE"] = mode
         env.pop("XLA_FLAGS", None)   # exactly one device per process
         procs.append(subprocess.Popen([sys.executable, str(script)],
                                       stdout=subprocess.PIPE,
@@ -110,12 +153,26 @@ def test_two_process_data_parallel(tmp_path):
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            pytest.fail("multiprocess worker hung")
+            pytest.fail(f"multiprocess worker hung (mode={mode})")
         outs.append(out)
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
         assert f"WORKER_OK {rank}" in out
+    return outs
 
+
+def _serial_baseline():
+    import lightgbm_tpu as lgb
+    X, y = _make_grid_problem()
+    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=10,
+                  learning_rate=0.2, verbose=-1)
+    return X, lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_two_process_data_parallel(tmp_path):
+    _run_workers(tmp_path)
     m0 = (tmp_path / "model_0.txt").read_text()
     m1 = (tmp_path / "model_1.txt").read_text()
     assert m0 == m1, "processes disagreed on the trained model"
@@ -127,19 +184,27 @@ def test_two_process_data_parallel(tmp_path):
     # mappers are identical by construction (discrete grid), so the
     # data-parallel trees must match serial training up to fp reduction order
     import lightgbm_tpu as lgb
-    rng = np.random.RandomState(7)
-    n, f = 3000, 8
-    X = (rng.randint(0, 24, size=(n, f)) / 4.0).astype(np.float32)
-    w = rng.randn(f)
-    y = ((X @ w + 2.0 * rng.randn(n)) > np.median(X @ w)).astype(np.float32)
-    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=10,
-                  learning_rate=0.2, verbose=-1)
-    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
-
+    X, bst = _serial_baseline()
     dist = lgb.Booster(model_str=m0)
-    ps = bst.predict(X[:500])
-    pd = dist.predict(X[:500])
-    np.testing.assert_allclose(pd, ps, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(dist.predict(X[:500]), bst.predict(X[:500]),
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_two_process_feature_parallel(tmp_path):
+    """Feature-parallel across processes with full replicated data: both
+    ranks must produce the identical model, equal to serial training."""
+    _run_workers(tmp_path, mode="feature")
+    m0 = (tmp_path / "model_0.txt").read_text()
+    m1 = (tmp_path / "model_1.txt").read_text()
+    assert m0 == m1
+
+    import lightgbm_tpu as lgb
+    X, bst = _serial_baseline()
+    dist = lgb.Booster(model_str=m0)
+    np.testing.assert_allclose(dist.predict(X[:500]), bst.predict(X[:500]),
+                               rtol=1e-3, atol=1e-3)
 
 
 @pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
@@ -147,32 +212,15 @@ def test_two_process_data_parallel(tmp_path):
 def test_distributed_findbin_matches_serial(tmp_path):
     """Both processes hold the SAME data: sharded-then-allgathered mappers
     must equal serially fitted ones bit-for-bit, and binning must agree."""
-    port = _free_port()
-    mlist = tmp_path / "mlist.txt"
-    mlist.write_text(f"127.0.0.1 {port}\n127.0.0.1 {port + 1}\n")
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ)
-        env.update(LGBM_TPU_RANK=str(rank), TEST_MLIST=str(mlist),
-                   TEST_OUT=str(tmp_path / f"unused_{rank}.txt"),
-                   TEST_MODE="findbin",
-                   PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
-        env.pop("XLA_FLAGS", None)
-        procs.append(subprocess.Popen([sys.executable, str(script)],
-                                      stdout=subprocess.PIPE,
-                                      stderr=subprocess.STDOUT, text=True,
-                                      env=env))
-    for rank, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("findbin worker hung")
-        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
-        assert f"WORKER_OK {rank}" in out
+    _run_workers(tmp_path, mode="findbin")
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_feature_parallel_rejects_partitioned_data(tmp_path):
+    """Feeding per-process row partitions to feature-parallel (full-data
+    contract) must fail loudly, not train on inconsistent replicas."""
+    _run_workers(tmp_path, mode="feature_bad")
 
 
 def _free_port() -> int:
