@@ -255,7 +255,8 @@ class GBDT:
                 # same full matrix (a user migrating from tree_learner=data
                 # may still be feeding per-process partitions — reject that
                 # loudly instead of training on silently inconsistent data)
-                sig = (binned.shape, zlib.crc32(binned.tobytes()))
+                sig = (binned.shape,
+                       zlib.crc32(np.ascontiguousarray(binned)))
                 sigs = allgather_object(sig)
                 if any(s != sig for s in sigs):
                     log.fatal("feature-parallel multi-process training "
@@ -561,6 +562,7 @@ class GBDT:
         """gbdt.cpp:583-600."""
         if self.iter_ <= 0:
             return
+        self._native_pred = None   # model-length alone can't detect this
         for k in reversed(range(self.num_class)):
             tree = self.models.pop()
             if tree.num_leaves > 1:
@@ -612,10 +614,46 @@ class GBDT:
 
     def predict(self, X, num_iteration: int = -1, raw_score: bool = False,
                 pred_leaf: bool = False, pred_early_stop: bool = False):
+        if not pred_leaf and not pred_early_stop:
+            out = self._native_predict(X, num_iteration, raw_score)
+            if out is not None:
+                return out
         p = self.predictor(num_iteration, raw_score, pred_early_stop)
         if pred_leaf:
             return p.predict_leaf_index(X)
         return p.predict(X, raw_score=raw_score)
+
+    def _native_predict(self, X, num_iteration: int, raw_score: bool):
+        """OpenMP serving path (predictor.hpp analogue) for batch predict —
+        the numpy per-tree walk stays as the fallback/oracle.  Returns None
+        when the native library is unavailable or the objective's output
+        transform is not implemented natively."""
+        from . import native
+        obj = self.objective.name if self.objective is not None else ""
+        native_transforms = ("regression", "regression_l1", "huber", "fair",
+                             "poisson", "binary", "multiclass",
+                             "multiclassova", "xentropy", "xentlambda",
+                             "lambdarank", "")
+        if not native.available() or (not raw_score
+                                      and obj not in native_transforms):
+            return None
+        try:
+            if (getattr(self, "_native_pred", None) is None
+                    or self._native_pred_ntrees != len(self.models)):
+                self._native_pred = native.NativePredictor(
+                    model_str=self.save_model_to_string())
+                self._native_pred_ntrees = len(self.models)
+            ni = num_iteration
+            if ni is not None and ni > 0 and self.boost_from_average_:
+                ni += 1     # the init tree counts as one stored iteration
+            out = self._native_pred.predict(
+                np.atleast_2d(np.asarray(X, np.float64)),
+                num_iteration=ni if ni and ni > 0 else -1,
+                raw_score=raw_score)
+            return out
+        except Exception as e:     # fall back to the python walk
+            log.debug("native predict unavailable (%s); using python path", e)
+            return None
 
     def current_iteration(self) -> int:
         return self.iter_ + self.num_init_iteration
@@ -839,6 +877,7 @@ class DART(GBDT):
         pairs = [(i, c) for i in self._drop_index
                  for c in range(self.num_class)]
         dropped = [self.models[self._model_index(i, c)] for i, c in pairs]
+        self._native_pred = None   # in-place shrink invalidates the cache
         # one batched traversal per valid set for ALL dropped trees
         valid_contribs = [self._trees_scores(dropped, vs.bins)
                           for vs in self.valid_sets]

@@ -500,12 +500,15 @@ void GBTN_Predict(void* h, const double* X, long long n, int f,
         double s = 0.0;
         for (int c = 0; c < k; ++c) { o[c] = std::exp(o[c] - mx); s += o[c]; }
         for (int c = 0; c < k; ++c) o[c] /= s;
+      } else if (m->objective.rfind("xentlambda", 0) == 0 ||
+                 m->objective.rfind("cross_entropy_lambda", 0) == 0) {
+        o[0] = std::log1p(std::exp(o[0]));
       } else if (m->objective.rfind("xentropy", 0) == 0 ||
                  m->objective.rfind("cross_entropy", 0) == 0) {
         o[0] = 1.0 / (1.0 + std::exp(-o[0]));
-      } else if (m->objective.rfind("poisson", 0) == 0) {
-        o[0] = std::exp(o[0]);
       }
+      // poisson is IDENTITY in the reference v2.0.5 (linear-score form,
+      // regression_objective.hpp:299-358 defines no ConvertOutput)
     }
   }
 }

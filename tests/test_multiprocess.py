@@ -63,7 +63,6 @@ y = ((X @ w + 2.0 * rng.randn(n)) > np.median(X @ w)).astype(np.float32)
 if os.environ.get("TEST_MODE") == "feature_bad":
     # contract violation: per-process partitions fed to feature-parallel
     # must be rejected loudly (differing data signatures)
-    from lightgbm_tpu.parallel.mesh import init_distributed_from_config
     lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
     params = dict(objective="binary", num_leaves=15, verbose=-1,
                   tree_learner="feature", num_machines=2,

@@ -125,3 +125,35 @@ def test_native_model_error():
     with pytest.raises(ValueError):
         native.NativePredictor(model_str="tree\nnum_class=1\nTree=0\n"
                                          "num_leaves=3\nleaf_value=1\n")
+
+
+def test_native_predict_objective_transforms():
+    """Native batch predict must match the python predictor for every
+    objective whose transform the native library claims (the python walk
+    is the oracle; poisson is IDENTITY per reference v2.0.5,
+    regression_objective.hpp:299-358 — no ConvertOutput)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.RandomState(0)
+    X = rng.randn(1500, 6).astype(np.float32)
+    w = rng.randn(6)
+    cases = [
+        ("binary", (X @ w > 0).astype(np.float32), {}),
+        ("regression", (X @ w).astype(np.float32), {}),
+        ("poisson", np.abs(X @ w).astype(np.float32), {}),
+        ("xentropy", ((X @ w > 0) * 0.7 + 0.15).astype(np.float32), {}),
+        ("xentlambda", ((X @ w > 0) * 0.7 + 0.15).astype(np.float32), {}),
+        ("multiclass", np.digitize(X @ w, [-1, 1]).astype(np.float32),
+         {"num_class": 3}),
+    ]
+    for obj, y, extra in cases:
+        p = dict(objective=obj, num_leaves=15, min_data_in_leaf=20,
+                 verbose=-1, **extra)
+        bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5)
+        nat = bst.inner._native_predict(X[:300], -1, False)
+        assert nat is not None, f"{obj}: native path not taken"
+        py = bst.inner.predictor().predict(X[:300], raw_score=False)
+        np.testing.assert_allclose(np.asarray(nat), np.asarray(py),
+                                   rtol=1e-12, atol=1e-12, err_msg=obj)
