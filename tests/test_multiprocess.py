@@ -93,8 +93,9 @@ if os.environ.get("TEST_MODE") == "feature":
 # this process's row partition (pre-partitioned parallel learning)
 lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
 
+learner = "voting" if os.environ.get("TEST_MODE") == "voting" else "data"
 params = dict(objective="binary", num_leaves=15, min_data_in_leaf=10,
-              learning_rate=0.2, verbose=-1, tree_learner="data",
+              learning_rate=0.2, verbose=-1, tree_learner=learner,
               num_machines=2, machine_list_file=mlist)
 d = lgb.Dataset(X[lo:hi], label=y[lo:hi])
 bst = lgb.train(params, d, num_boost_round=5)
@@ -212,6 +213,22 @@ def test_distributed_findbin_matches_serial(tmp_path):
     """Both processes hold the SAME data: sharded-then-allgathered mappers
     must equal serially fitted ones bit-for-bit, and binning must agree."""
     _run_workers(tmp_path, mode="findbin")
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_two_process_voting_parallel(tmp_path):
+    """PV-tree voting learner across process boundaries: ranks must agree
+    on the model (vote compression makes serial equality approximate, so
+    only cross-rank identity is asserted)."""
+    _run_workers(tmp_path, mode="voting")
+    m0 = (tmp_path / "model_0.txt").read_text()
+    m1 = (tmp_path / "model_1.txt").read_text()
+    assert m0 == m1, "voting ranks disagreed on the trained model"
+    assert m0.count("Tree=") >= 5
+    r0 = (tmp_path / "model_0.txt.reg").read_text()
+    r1 = (tmp_path / "model_1.txt.reg").read_text()
+    assert r0 == r1, "voting regression/boost-from-average diverged"
 
 
 @pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
