@@ -143,7 +143,26 @@ def train(params: Dict[str, Any], train_set: Dataset,
     return booster
 
 
-CVBooster = collections.namedtuple("CVBooster", ["boosters"])
+class CVBooster:
+    """All per-fold boosters of a cv run (reference engine.py:230-252):
+    unknown attribute access dispatches the call to every fold's booster
+    and returns the list of results."""
+
+    def __init__(self, boosters=None):
+        self.boosters = list(boosters or [])
+        self.best_iteration = -1
+
+    def append(self, booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs)
+                    for b in self.boosters]
+        return handler
 
 
 def _make_n_folds(full_data: Dataset, nfold: int, params: Dict,

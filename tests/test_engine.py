@@ -155,3 +155,22 @@ def test_custom_objective():
     pred_raw = bst.predict(X, raw_score=True)
     p = 1.0 / (1.0 + np.exp(-pred_raw))
     assert _logloss(y, p) < 0.15
+
+
+def test_cv_and_cvbooster(binary_example):
+    X, y, _, _ = binary_example
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=20,
+                  learning_rate=0.2, verbose=-1)
+    res = lgb.cv(params, lgb.Dataset(X[:2000], label=y[:2000]),
+                 num_boost_round=5, nfold=3, stratified=True, seed=1)
+    key = next(k for k in res if k.endswith("-mean"))
+    assert len(res[key]) == 5
+    assert res[key][-1] <= res[key][0]      # logloss decreases over rounds
+
+    from lightgbm_tpu.engine import CVBooster
+    cb = CVBooster()
+    for _ in range(2):
+        cb.append(lgb.train(params, lgb.Dataset(X[:1000], label=y[:1000]),
+                            num_boost_round=2))
+    preds = cb.predict(X[:10])              # dispatches to every fold
+    assert len(preds) == 2 and len(preds[0]) == 10
