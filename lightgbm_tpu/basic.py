@@ -420,6 +420,20 @@ class Booster:
         (gbdt.cpp:467-483), so the numbering matches."""
         return float(self.inner.models[tree_id].leaf_value[leaf_id])
 
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        """LGBM_BoosterSetLeafValue analogue: overwrite one leaf's raw
+        output (same tree numbering as get_leaf_output)."""
+        self.inner.models[tree_id].leaf_value[leaf_id] = float(value)
+        self.inner._native_pred = None   # serving cache now stale
+        return self
+
+    def merge(self, other: "Booster") -> "Booster":
+        """LGBM_BoosterMerge: prepend other's trees to this model
+        (reference GBDT::MergeFrom ordering)."""
+        self.inner.merge_from(other.inner)
+        return self
+
     def eval(self, data: Dataset, name: str, feval=None):
         """Evaluate the current model on an arbitrary dataset
         (reference Booster.eval)."""
@@ -440,7 +454,8 @@ class Booster:
         for k, v in canon.items():
             setattr(self.inner.config, k, type(getattr(self.inner.config, k))(v)
                     if not isinstance(getattr(self.inner.config, k), list) else v)
-        return self
+        self.params.update(canon)   # keep the param record in sync (reference
+        return self                 # Booster.reset_parameter does the same)
 
     # -- evaluation ---------------------------------------------------------
 

@@ -17,6 +17,7 @@ trick), valid scores use jitted binned traversal.
 """
 from __future__ import annotations
 
+import copy
 import io
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -657,6 +658,19 @@ class GBDT:
 
     def current_iteration(self) -> int:
         return self.iter_ + self.num_init_iteration
+
+    def merge_from(self, other: "GBDT") -> None:
+        """GBDT::MergeFrom (gbdt.h:47-66): the other model's trees are
+        PREPENDED (they become init iterations) and this model's follow.
+        num_init_iteration grows by the merged count so current_iteration
+        keeps matching total trees / num_class — the observable the
+        reference gets by deriving iteration counts from models_.size().
+        Like the reference, training scores are not recomputed — merge is
+        a model-combination operation for predict/save."""
+        merged = [copy.deepcopy(t) for t in other.models]
+        self.num_init_iteration += len(merged) // max(self.num_class, 1)
+        self.models = merged + self.models
+        self._native_pred = None
 
     # ------------------------------------------------------------- model file
 

@@ -115,7 +115,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.GBTN_GetLastError.argtypes = []
         lib.GBTN_DatasetCreateFromMat.restype = c_i
         lib.GBTN_DatasetCreateFromMat.argtypes = [
-            c_d_p, c_ll, c_i, ctypes.c_char_p, c_f_p,
+            c_d_p, c_ll, c_i, ctypes.c_char_p, c_f_p, c_p,
             ctypes.POINTER(c_p)]
         lib.GBTN_DatasetFree.restype = c_i
         lib.GBTN_DatasetFree.argtypes = [c_p]
@@ -133,6 +133,104 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.GBTN_BoosterGetNumClass.argtypes = [c_p, c_i_p]
         lib.GBTN_BoosterFree.restype = c_i
         lib.GBTN_BoosterFree.argtypes = [c_p]
+
+        c_c_p = ctypes.c_char_p
+        c_cpp = ctypes.POINTER(c_c_p)       # char** (string arrays)
+        c_pp = ctypes.POINTER(c_p)
+        c_vpp = ctypes.POINTER(c_p)         # const void** out
+        lib.GBTN_DatasetCreateFromFile.restype = c_i
+        lib.GBTN_DatasetCreateFromFile.argtypes = [c_c_p, c_c_p, c_p, c_pp]
+        lib.GBTN_DatasetCreateFromCSR.restype = c_i
+        lib.GBTN_DatasetCreateFromCSR.argtypes = [
+            c_i_p, c_ll, c_i_p, c_d_p, c_ll, c_ll, c_c_p, c_p, c_pp]
+        lib.GBTN_DatasetCreateFromCSC.restype = c_i
+        lib.GBTN_DatasetCreateFromCSC.argtypes = [
+            c_i_p, c_ll, c_i_p, c_d_p, c_ll, c_ll, c_c_p, c_p, c_pp]
+        lib.GBTN_DatasetCreateEmpty.restype = c_i
+        lib.GBTN_DatasetCreateEmpty.argtypes = [c_ll, c_i, c_c_p, c_p, c_pp]
+        lib.GBTN_DatasetPushRows.restype = c_i
+        lib.GBTN_DatasetPushRows.argtypes = [c_p, c_d_p, c_ll, c_i, c_ll]
+        lib.GBTN_DatasetPushRowsByCSR.restype = c_i
+        lib.GBTN_DatasetPushRowsByCSR.argtypes = [
+            c_p, c_i_p, c_ll, c_i_p, c_d_p, c_ll, c_ll, c_ll]
+        lib.GBTN_DatasetSetField.restype = c_i
+        lib.GBTN_DatasetSetField.argtypes = [c_p, c_c_p, c_p, c_ll, c_i]
+        lib.GBTN_DatasetGetField.restype = c_i
+        lib.GBTN_DatasetGetField.argtypes = [c_p, c_c_p, c_ll_p, c_vpp,
+                                             c_i_p]
+        lib.GBTN_DatasetGetNumData.restype = c_i
+        lib.GBTN_DatasetGetNumData.argtypes = [c_p, c_ll_p]
+        lib.GBTN_DatasetGetNumFeature.restype = c_i
+        lib.GBTN_DatasetGetNumFeature.argtypes = [c_p, c_i_p]
+        lib.GBTN_DatasetSetFeatureNames.restype = c_i
+        lib.GBTN_DatasetSetFeatureNames.argtypes = [c_p, c_cpp, c_i]
+        lib.GBTN_DatasetGetFeatureNames.restype = c_i
+        lib.GBTN_DatasetGetFeatureNames.argtypes = [c_p, c_cpp, c_i, c_i_p]
+        lib.GBTN_DatasetSaveBinary.restype = c_i
+        lib.GBTN_DatasetSaveBinary.argtypes = [c_p, c_c_p]
+        lib.GBTN_DatasetLoadBinary.restype = c_i
+        lib.GBTN_DatasetLoadBinary.argtypes = [c_c_p, c_pp]
+        lib.GBTN_DatasetGetSubset.restype = c_i
+        lib.GBTN_DatasetGetSubset.argtypes = [c_p, c_i_p, c_ll, c_c_p, c_pp]
+
+        lib.GBTN_BoosterCreateFromModelfile.restype = c_i
+        lib.GBTN_BoosterCreateFromModelfile.argtypes = [c_c_p, c_i_p, c_pp]
+        lib.GBTN_BoosterLoadModelFromString.restype = c_i
+        lib.GBTN_BoosterLoadModelFromString.argtypes = [c_c_p, c_i_p, c_pp]
+        lib.GBTN_BoosterMerge.restype = c_i
+        lib.GBTN_BoosterMerge.argtypes = [c_p, c_p]
+        lib.GBTN_BoosterAddValidData.restype = c_i
+        lib.GBTN_BoosterAddValidData.argtypes = [c_p, c_p, c_c_p]
+        lib.GBTN_BoosterResetTrainingData.restype = c_i
+        lib.GBTN_BoosterResetTrainingData.argtypes = [c_p, c_p]
+        lib.GBTN_BoosterResetParameter.restype = c_i
+        lib.GBTN_BoosterResetParameter.argtypes = [c_p, c_c_p]
+        lib.GBTN_BoosterUpdateOneIterCustom.restype = c_i
+        lib.GBTN_BoosterUpdateOneIterCustom.argtypes = [c_p, c_f_p, c_f_p,
+                                                        c_ll, c_i_p]
+        lib.GBTN_BoosterRollbackOneIter.restype = c_i
+        lib.GBTN_BoosterRollbackOneIter.argtypes = [c_p]
+        lib.GBTN_BoosterGetCurrentIteration.restype = c_i
+        lib.GBTN_BoosterGetCurrentIteration.argtypes = [c_p, c_i_p]
+        lib.GBTN_BoosterGetNumFeature.restype = c_i
+        lib.GBTN_BoosterGetNumFeature.argtypes = [c_p, c_i_p]
+        lib.GBTN_BoosterGetFeatureNames.restype = c_i
+        lib.GBTN_BoosterGetFeatureNames.argtypes = [c_p, c_cpp, c_i, c_i_p]
+        lib.GBTN_BoosterGetEvalCounts.restype = c_i
+        lib.GBTN_BoosterGetEvalCounts.argtypes = [c_p, c_i_p]
+        lib.GBTN_BoosterGetEvalNames.restype = c_i
+        lib.GBTN_BoosterGetEvalNames.argtypes = [c_p, c_cpp, c_i, c_i_p]
+        lib.GBTN_BoosterGetEval.restype = c_i
+        lib.GBTN_BoosterGetEval.argtypes = [c_p, c_i, c_i_p, c_d_p]
+        lib.GBTN_BoosterGetNumPredict.restype = c_i
+        lib.GBTN_BoosterGetNumPredict.argtypes = [c_p, c_i, c_ll_p]
+        lib.GBTN_BoosterGetPredict.restype = c_i
+        lib.GBTN_BoosterGetPredict.argtypes = [c_p, c_i, c_ll_p, c_d_p]
+        lib.GBTN_BoosterGetLeafValue.restype = c_i
+        lib.GBTN_BoosterGetLeafValue.argtypes = [c_p, c_i, c_i,
+                                                 ctypes.POINTER(
+                                                     ctypes.c_double)]
+        lib.GBTN_BoosterSetLeafValue.restype = c_i
+        lib.GBTN_BoosterSetLeafValue.argtypes = [c_p, c_i, c_i,
+                                                 ctypes.c_double]
+        lib.GBTN_BoosterSaveModelToString.restype = c_i
+        lib.GBTN_BoosterSaveModelToString.argtypes = [c_p, c_i, c_ll,
+                                                      c_ll_p, c_c_p]
+        lib.GBTN_BoosterDumpModel.restype = c_i
+        lib.GBTN_BoosterDumpModel.argtypes = [c_p, c_i, c_ll, c_ll_p, c_c_p]
+        lib.GBTN_BoosterCalcNumPredict.restype = c_i
+        lib.GBTN_BoosterCalcNumPredict.argtypes = [c_p, c_ll, c_i, c_i,
+                                                   c_ll_p]
+        lib.GBTN_BoosterPredict.restype = c_i
+        lib.GBTN_BoosterPredict.argtypes = [c_p, c_d_p, c_ll, c_i, c_i, c_i,
+                                            c_ll, c_ll_p, c_d_p]
+        lib.GBTN_BoosterPredictForCSR.restype = c_i
+        lib.GBTN_BoosterPredictForCSR.argtypes = [
+            c_p, c_i_p, c_ll, c_i_p, c_d_p, c_ll, c_ll, c_i, c_i, c_ll,
+            c_ll_p, c_d_p]
+        lib.GBTN_BoosterPredictForFile.restype = c_i
+        lib.GBTN_BoosterPredictForFile.argtypes = [c_p, c_c_p, c_i, c_c_p,
+                                                   c_i, c_i]
         _has_train_api = True
     except AttributeError:
         _has_train_api = False

@@ -136,6 +136,10 @@ def _run_workers(tmp_path, mode=None):
     procs = []
     for rank in range(2):
         env = dict(os.environ)
+        # the worker script lives in tmp_path, so sys.path[0] is NOT the
+        # repo — make the package importable without requiring an install
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         env.update(LGBM_TPU_RANK=str(rank), TEST_MLIST=str(mlist),
                    TEST_OUT=str(tmp_path / f"model_{rank}.txt"),
                    PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
