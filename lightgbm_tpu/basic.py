@@ -54,12 +54,60 @@ class Dataset:
 
     # -- lazy construction --------------------------------------------------
 
+    def _distributed_row_selection(self, cfg: Config,
+                                   n_rows: int) -> Optional[np.ndarray]:
+        """Row→machine assignment when several processes train
+        data/voting-parallel from the SAME data file without
+        pre-partitioning (dataset_loader.cpp LoadTextDataToMemory:563-607):
+        a shared-seed random draw per row — per QUERY when query data
+        exists — keeps exactly the rows assigned to this rank, so the
+        union over ranks is a disjoint cover of the file.  Caller
+        established the dist-rows predicate and the distributed runtime."""
+        import jax
+        if jax.process_count() <= 1:
+            return None
+        from .utils.random import make_rng
+        nm = jax.process_count()
+        rank = jax.process_index()
+        rng = make_rng(cfg.data_random_seed)
+        if self.group is not None:
+            counts = np.asarray(self.group, dtype=np.int64)
+            assign = rng.integers(0, nm, size=len(counts))
+            row_q = np.repeat(np.arange(len(counts)), counts)
+            sel = np.flatnonzero(assign[row_q] == rank)
+            self.group = counts[assign == rank]
+        else:
+            assign = rng.integers(0, nm, size=n_rows)
+            sel = np.flatnonzero(assign == rank)
+        log.info("Distributed loading: rank %d keeps %d of %d rows",
+                 rank, len(sel), n_rows)
+        return sel
+
     def construct(self, config: Optional[Config] = None) -> "Dataset":
         if self._constructed is not None:
             return self
         cfg = config or config_from_params(self.params)
+        # shared-file row distribution applies to the TRAIN file only —
+        # validation data (reference set) stays whole on every rank, like
+        # the reference's LoadFromFileAlignWithOtherDataset
+        dist_rows = (cfg.num_machines > 1 and not cfg.is_pre_partition
+                     and cfg.tree_learner in ("data", "voting")
+                     and self.reference is None
+                     and isinstance(self.data, (str, os.PathLike)))
+        if dist_rows:
+            # bring the distributed runtime up BEFORE any jax backend
+            # touch, so an early construct() (num_data, save_binary, ...)
+            # shards exactly like the one inside train() — idempotent
+            from .parallel.mesh import init_distributed_from_config
+            init_distributed_from_config(cfg)
+            if cfg.use_two_round_loading:
+                log.warning("use_two_round_loading falls back to in-memory "
+                            "loading when rows are distributed across "
+                            "machines (set pre_partition=true to stream "
+                            "per-machine files)")
         if (isinstance(self.data, (str, os.PathLike))
-                and cfg.use_two_round_loading and self.reference is None):
+                and cfg.use_two_round_loading and self.reference is None
+                and not dist_rows):
             # two-round streamed loading (dataset_loader.cpp:181-207): the
             # raw float matrix never materializes — sample pass, then a
             # chunked bin-as-you-read pass into the final uint8/16 matrix
@@ -113,6 +161,26 @@ class Dataset:
                 self.group = np.diff(meta_probe.query_boundaries)
             if self.init_score is None and meta_probe.init_score is not None:
                 self.init_score = meta_probe.init_score
+            sel = self._distributed_row_selection(cfg, len(mat)) \
+                if dist_rows else None
+            if sel is not None:   # this rank's shard of the shared file
+                n_full = len(mat)
+                mat = mat[sel]
+                if self.label is not None:
+                    self.label = np.asarray(self.label)[sel]
+                if self.weight is not None:
+                    self.weight = np.asarray(self.weight)[sel]
+                if self.init_score is not None:
+                    init = np.asarray(self.init_score)
+                    k = max(int(getattr(cfg, "num_class", 1) or 1), 1)
+                    if k > 1 and init.size == k * n_full:
+                        # flattened [num_class, N] layout: select the
+                        # shard's rows within every class block
+                        init = init.reshape(k, n_full)[:, sel].ravel()
+                    else:
+                        init = init[sel]
+                    self.init_score = init
+                # self.group was already partitioned by query unit
         else:
             mat = _to_matrix(self.data)
 

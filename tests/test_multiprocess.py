@@ -90,6 +90,22 @@ if os.environ.get("TEST_MODE") == "feature":
     print("WORKER_OK", rank)
     sys.exit(0)
 
+if os.environ.get("TEST_MODE") == "sharedfile":
+    # both ranks point at the SAME data file, not pre-partitioned: the
+    # loader must give each rank a disjoint row shard
+    # (dataset_loader.cpp LoadTextDataToMemory:563-607) and the ranks
+    # must still agree on the model
+    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=10,
+                  learning_rate=0.2, verbose=-1, tree_learner="data",
+                  num_machines=2, machine_list_file=mlist)
+    d = lgb.Dataset(os.environ["TEST_DATA"])
+    bst = lgb.train(params, d, num_boost_round=5)
+    nd = d.num_data()
+    assert 0.3 * n < nd < 0.7 * n, nd     # a proper shard, not the file
+    bst.save_model(out)
+    print("WORKER_OK", rank)
+    sys.exit(0)
+
 # this process's row partition (pre-partitioned parallel learning)
 lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
 
@@ -124,7 +140,7 @@ def _make_grid_problem():
     return X, y
 
 
-def _run_workers(tmp_path, mode=None):
+def _run_workers(tmp_path, mode=None, extra_env=None):
     """Spawn the 2-process worker pair; returns per-rank stdout after
     asserting both exited 0 with WORKER_OK."""
     port = _free_port()
@@ -145,6 +161,8 @@ def _run_workers(tmp_path, mode=None):
                    PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
         if mode is not None:
             env["TEST_MODE"] = mode
+        if extra_env:
+            env.update(extra_env)
         env.pop("XLA_FLAGS", None)   # exactly one device per process
         procs.append(subprocess.Popen([sys.executable, str(script)],
                                       stdout=subprocess.PIPE,
@@ -208,6 +226,33 @@ def test_two_process_feature_parallel(tmp_path):
     X, bst = _serial_baseline()
     dist = lgb.Booster(model_str=m0)
     np.testing.assert_allclose(dist.predict(X[:500]), bst.predict(X[:500]),
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_two_process_shared_file_distributes_rows(tmp_path):
+    """Both ranks load the SAME data file with tree_learner=data and no
+    pre-partitioning: the loader hands each rank a disjoint shard (the
+    worker asserts its local row count) and training still produces one
+    agreed model ~ equal to serial training on the full file."""
+    X, y = _make_grid_problem()
+    data_path = tmp_path / "shared.tsv"
+    np.savetxt(data_path, np.column_stack([y, X]), delimiter="\t",
+               fmt="%.6g")
+    _run_workers(tmp_path, mode="sharedfile",
+                 extra_env={"TEST_DATA": str(data_path)})
+    m0 = (tmp_path / "model_0.txt").read_text()
+    m1 = (tmp_path / "model_1.txt").read_text()
+    assert m0 == m1, "ranks disagreed on the shared-file model"
+
+    import lightgbm_tpu as lgb
+    Xs, bst = _serial_baseline()
+    dist = lgb.Booster(model_str=m0)
+    # disjoint shards + identical mappers => summed histograms equal the
+    # serial ones, so this matches serial training like the
+    # pre-partitioned data-parallel test does
+    np.testing.assert_allclose(dist.predict(Xs[:500]), bst.predict(Xs[:500]),
                                rtol=1e-3, atol=1e-3)
 
 
