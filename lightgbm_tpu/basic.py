@@ -84,16 +84,34 @@ class Dataset:
         return sel
 
     def construct(self, config: Optional[Config] = None) -> "Dataset":
-        if self._constructed is not None:
-            return self
         cfg = config or config_from_params(self.params)
         # shared-file row distribution applies to the TRAIN file only —
         # validation data (reference set) stays whole on every rank, like
         # the reference's LoadFromFileAlignWithOtherDataset
-        dist_rows = (cfg.num_machines > 1 and not cfg.is_pre_partition
-                     and cfg.tree_learner in ("data", "voting")
-                     and self.reference is None
-                     and isinstance(self.data, (str, os.PathLike)))
+        dist_intent = (cfg.num_machines > 1 and not cfg.is_pre_partition
+                       and cfg.tree_learner in ("data", "voting")
+                       and self.reference is None)
+        dist_rows = dist_intent and isinstance(self.data, (str, os.PathLike))
+        if self._constructed is not None:
+            if (dist_intent and getattr(self, "_loaded_from_file", False)
+                    and not getattr(self, "_dist_sharded", False)):
+                # constructed earlier without the distribution params
+                # (e.g. num_data() before train()): training data-parallel
+                # on full per-rank replicas would double-count every row —
+                # rebuild from the file with the real config
+                if not isinstance(self.data, (str, os.PathLike)):
+                    log.fatal(
+                        "Dataset was constructed without distributed row "
+                        "partitioning and the raw file reference was "
+                        "freed; pass the num_machines/tree_learner params "
+                        "to the Dataset or construct it inside train()")
+                log.warning("Reconstructing dataset with distributed row "
+                            "partitioning (it was first constructed "
+                            "without the parallel params)")
+                self._constructed = None
+                self.label = None     # reload labels from the file too
+            else:
+                return self
         if dist_rows:
             # bring the distributed runtime up BEFORE any jax backend
             # touch, so an early construct() (num_data, save_binary, ...)
@@ -140,6 +158,8 @@ class Dataset:
                 feature_names=names, categorical_features=cat_idx)
             self.label = self._constructed.metadata.label
             self.raw = None
+            self._loaded_from_file = True
+            self._dist_sharded = False
             if self.free_raw_data:
                 self.data = None
             return self
@@ -163,6 +183,8 @@ class Dataset:
                 self.init_score = meta_probe.init_score
             sel = self._distributed_row_selection(cfg, len(mat)) \
                 if dist_rows else None
+            self._loaded_from_file = True
+            self._dist_sharded = sel is not None
             if sel is not None:   # this rank's shard of the shared file
                 n_full = len(mat)
                 mat = mat[sel]

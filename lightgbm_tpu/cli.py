@@ -92,37 +92,102 @@ def run_predict(cfg: Config, params: Dict[str, str]) -> None:
 
 
 def run_convert_model(cfg: Config, params: Dict[str, str]) -> None:
-    """convert_model task: emit the model as portable C++ if-else code
-    (gbdt.cpp ModelToIfElse analogue; simplified standalone function)."""
+    """convert_model task: emit the model as portable, dependency-free C++
+    if-else code (gbdt.cpp ModelToIfElse analogue) with the EXACT
+    NumericalDecision/CategoricalDecision semantics of tree.h:231-313 —
+    all three missing modes, default-left routing, categorical bitsets,
+    multiclass tree interleaving.  The generated translation unit exports
+
+        extern "C" void PredictRawAll(const double* fval, double* out);
+        double PredictRaw(const double* fval);      // num_class == 1 only
+
+    and is the compiled-model oracle for the conversion-consistency test
+    (the reference's tests/cpp_test discipline)."""
     booster = Booster(model_file=cfg.input_model, params=params)
     trees = booster.inner.models
-    lines = ["#include <cmath>", "#include <vector>", "",
-             "double PredictRaw(const double* fval) {", "  double sum = 0.0;"]
+    k = max(booster.inner.num_class, 1)
+    lines = ["#include <cmath>", "",
+             "// categorical split bitsets (tree.h cat_threshold)"]
     for ti, t in enumerate(trees):
-        lines.append(f"  // tree {ti}")
-        def emit(node, indent):
+        for node in range(t.num_leaves - 1):
+            if t.is_categorical(node):
+                bits = ", ".join(f"{int(b)}u" for b in t.cat_bitset(node))
+                lines.append(f"static const unsigned int kCat_{ti}_{node}"
+                             f"[] = {{{bits}}};")
+    lines += [
+        "",
+        "// CategoricalDecision (tree.h:268-283)",
+        "static bool InBitset(const unsigned int* bits, int n, double fval,",
+        "                     bool nan_is_missing) {",
+        "  if (std::isnan(fval)) {",
+        "    if (nan_is_missing) return false;",
+        "    fval = 0.0;",
+        "  }",
+        "  const int v = static_cast<int>(fval);",
+        "  if (v < 0) return false;",
+        "  const int i1 = v / 32, i2 = v % 32;",
+        "  return i1 < n && ((bits[i1] >> i2) & 1u);",
+        "}",
+        "",
+        'extern "C" void PredictRawAll(const double* fval, double* out) {',
+        f"  for (int c = 0; c < {k}; ++c) out[c] = 0.0;",
+    ]
+    for ti, t in enumerate(trees):
+        cls = ti % k
+        lines.append(f"  // tree {ti} (class {cls})")
+        if t.num_leaves <= 1:
+            lines.append(f"  out[{cls}] += {t.leaf_value[0]:.17g};")
+            continue
+        # explicit stack, not recursion — leaf-wise trees can be deeper
+        # than the Python recursion limit
+        stack = [("node", 0, 1)]
+        while stack:
+            kind, item, indent = stack.pop()
+            if kind == "text":
+                lines.append(item)
+                continue
+            node = item
             pad = "  " * indent
             if node < 0:
                 leaf = ~node
-                lines.append(f"{pad}sum += {t.leaf_value[leaf]:.17g};")
-                return
+                lines.append(f"{pad}out[{cls}] += "
+                             f"{t.leaf_value[leaf]:.17g};")
+                continue
             f = int(t.split_feature[node])
-            thr = float(t.threshold[node])
-            mt = t.missing_type(node)
-            dl = t.default_left(node)
-            cond = f"fval[{f}] <= {thr:.17g}"
-            if mt == 2:
-                cond = (f"(std::isnan(fval[{f}]) ? {str(dl).lower()} : ({cond}))")
+            if t.is_categorical(node):
+                nbits = len(t.cat_bitset(node))
+                nan_missing = "true" if t.missing_type(node) == 2 else "false"
+                cond = (f"InBitset(kCat_{ti}_{node}, {nbits}, fval[{f}], "
+                        f"{nan_missing})")
+            else:
+                # NumericalDecision (tree.h:231-266): NaN maps to 0.0
+                # unless missing_type is NaN; zero-range/NaN missing
+                # routes by default_left; otherwise v <= threshold
+                thr = float(t.threshold[node])
+                mt = t.missing_type(node)
+                dl = "true" if t.default_left(node) else "false"
+                v = f"(std::isnan(fval[{f}]) ? 0.0 : fval[{f}])"
+                if mt == 2:       # NaN is the missing value
+                    cond = (f"(std::isnan(fval[{f}]) ? {dl} : "
+                            f"(fval[{f}] <= {thr:.17g}))")
+                elif mt == 1:     # zero range is the missing value
+                    cond = (f"(std::fabs({v}) <= 1e-20 ? {dl} : "
+                            f"({v} <= {thr:.17g}))")
+                else:             # no missing handling; NaN folds to 0.0
+                    cond = f"{v} <= {thr:.17g}"
             lines.append(f"{pad}if ({cond}) {{")
-            emit(int(t.left_child[node]), indent + 1)
-            lines.append(f"{pad}}} else {{")
-            emit(int(t.right_child[node]), indent + 1)
-            lines.append(f"{pad}}}")
-        if t.num_leaves > 1:
-            emit(0, 1)
-        else:
-            lines.append(f"  sum += {t.leaf_value[0]:.17g};")
-    lines += ["  return sum;", "}"]
+            stack.append(("text", f"{pad}}}", 0))
+            stack.append(("node", int(t.right_child[node]), indent + 1))
+            stack.append(("text", f"{pad}}} else {{", 0))
+            stack.append(("node", int(t.left_child[node]), indent + 1))
+    lines.append("}")
+    if k == 1:
+        lines += ["",
+                  'extern "C" double PredictRaw(const double* fval) {',
+                  "  double out = 0.0;",
+                  "  PredictRawAll(fval, &out);",
+                  "  return out;",
+                  "}"]
     with open(cfg.convert_model, "w") as f:
         f.write("\n".join(lines) + "\n")
     log.info("Model converted to %s", cfg.convert_model)

@@ -99,6 +99,10 @@ if os.environ.get("TEST_MODE") == "sharedfile":
                   learning_rate=0.2, verbose=-1, tree_learner="data",
                   num_machines=2, machine_list_file=mlist)
     d = lgb.Dataset(os.environ["TEST_DATA"])
+    if os.environ.get("TEST_EARLY") == "1":
+        # constructing BEFORE train (no parallel params) must not leak an
+        # unsharded dataset into distributed training — train() rebuilds
+        assert d.num_data() == n
     bst = lgb.train(params, d, num_boost_round=5)
     nd = d.num_data()
     assert 0.3 * n < nd < 0.7 * n, nd     # a proper shard, not the file
@@ -245,6 +249,16 @@ def test_two_process_shared_file_distributes_rows(tmp_path):
     m0 = (tmp_path / "model_0.txt").read_text()
     m1 = (tmp_path / "model_1.txt").read_text()
     assert m0 == m1, "ranks disagreed on the shared-file model"
+
+    # same flow with an eager construct() before train(): the dataset must
+    # be rebuilt with sharding, not reused unsharded
+    early = tmp_path / "early"
+    early.mkdir()
+    _run_workers(early, mode="sharedfile",
+                 extra_env={"TEST_DATA": str(data_path), "TEST_EARLY": "1"})
+    e0 = (early / "model_0.txt").read_text()
+    assert e0 == (early / "model_1.txt").read_text()
+    assert e0 == m0, "early-construct path trained a different model"
 
     import lightgbm_tpu as lgb
     Xs, bst = _serial_baseline()
