@@ -109,7 +109,10 @@ class Dataset:
                             "partitioning (it was first constructed "
                             "without the parallel params)")
                 self._constructed = None
-                self.label = None     # reload labels from the file too
+                if getattr(self, "_label_from_file", False):
+                    self.label = None   # reload file labels at full length;
+                                        # a user-supplied label is kept and
+                                        # sharded by [sel] like weight
             else:
                 return self
         if dist_rows:
@@ -123,6 +126,22 @@ class Dataset:
                             "loading when rows are distributed across "
                             "machines (set pre_partition=true to stream "
                             "per-machine files)")
+        elif isinstance(self.data, (str, os.PathLike)) \
+                and self.reference is None:
+            # CheckCanLoadFromBin (dataset_loader.cpp:980-1018): prefer an
+            # existing "<data>.bin" cache; accept the data file itself
+            # being a binary cache
+            path = str(self.data)
+            for candidate in (path + ".bin", path):
+                if self._is_binary_cache(candidate):
+                    log.info("Loading dataset from binary cache %s",
+                             candidate)
+                    self._constructed = \
+                        self._load_binary_training_data(candidate)
+                    self.label = self._constructed.metadata.label
+                    self._loaded_from_file = True
+                    self._dist_sharded = False
+                    return self
         if (isinstance(self.data, (str, os.PathLike))
                 and cfg.use_two_round_loading and self.reference is None
                 and not dist_rows):
@@ -160,6 +179,8 @@ class Dataset:
             self.raw = None
             self._loaded_from_file = True
             self._dist_sharded = False
+            if cfg.is_save_binary_file:
+                self._save_binary_cache()
             if self.free_raw_data:
                 self.data = None
             return self
@@ -169,6 +190,7 @@ class Dataset:
                 path, has_header=cfg.has_header, label_idx=0)
             if self.label is None:
                 self.label = labels
+                self._label_from_file = True
             mat = feats
             if names and self.feature_name == "auto":
                 self.feature_name = names
@@ -185,6 +207,8 @@ class Dataset:
                 if dist_rows else None
             self._loaded_from_file = True
             self._dist_sharded = sel is not None
+            self._want_binary_save = (cfg.is_save_binary_file
+                                      and sel is None)
             if sel is not None:   # this rank's shard of the shared file
                 n_full = len(mat)
                 mat = mat[sel]
@@ -237,9 +261,19 @@ class Dataset:
             else np.asarray(self.init_score),
             feature_names=names, categorical_features=cat_idx, reference=ref)
         self.raw = mat if not self.free_raw_data else None
+        if getattr(self, "_want_binary_save", False):
+            self._want_binary_save = False
+            self._save_binary_cache()
         if self.free_raw_data:
             self.data = None
         return self
+
+    def _save_binary_cache(self) -> None:
+        """is_save_binary_file: write the "<data>.bin" cache next to the
+        text file (dataset_loader.cpp SaveBinaryFile flow)."""
+        bin_path = str(self.data) + ".bin"
+        self.save_binary(bin_path)
+        log.info("Saved binary dataset cache to %s", bin_path)
 
     @property
     def constructed(self) -> TrainingData:
@@ -344,14 +378,44 @@ class Dataset:
             cur = cur.reference
         return set(chain)
 
+    def ensure_raw(self) -> Optional[np.ndarray]:
+        """Raw feature matrix for the consumers that need one (cv, subset,
+        continued training).  When the dataset was constructed without
+        materializing it — binary-cache load or streamed loading — the
+        matrix is recovered by re-parsing the original text file, provided
+        that file still exists, is not itself a cache, and agrees with the
+        constructed row count (guards against stale caches)."""
+        if self.raw is not None:
+            return self.raw
+        if isinstance(self.data, (str, os.PathLike)) \
+                and not self._is_binary_cache(str(self.data)):
+            cfg = config_from_params(self.params)
+            try:
+                feats, _, _ = load_text_file(str(self.data),
+                                             has_header=cfg.has_header)
+            except Exception as e:
+                log.warning("Could not recover raw data from %s: %s",
+                            self.data, e)
+                return None
+            if self._constructed is not None \
+                    and len(feats) != self._constructed.num_data:
+                log.warning("Raw file %s has %d rows but the constructed "
+                            "dataset has %d — refusing the mismatch",
+                            self.data, len(feats),
+                            self._constructed.num_data)
+                return None
+            self.raw = feats
+            return self.raw
+        return None
+
     def subset(self, used_indices, params=None) -> "Dataset":
         """Row-subset Dataset sharing this dataset's bin mappers
         (reference Dataset.subset; requires raw data retained in memory)."""
         self.construct()
-        if self.raw is None or isinstance(self.raw, (str, os.PathLike)):
+        raw = self.ensure_raw()
+        if raw is None or isinstance(raw, (str, os.PathLike)):
             log.fatal("Cannot subset: raw data not in memory (construct "
                       "with free_raw_data=False from an in-memory matrix)")
-        raw = self.raw
         idx = np.asarray(used_indices, dtype=np.int64)
         label = self.get_label()
         w = self.get_weight()
@@ -380,39 +444,116 @@ class Dataset:
     def num_feature(self) -> int:
         return self.constructed.num_total_features
 
+    # token identifying our binary dataset cache files — the analogue of
+    # Dataset::binary_file_token checked by CheckCanLoadFromBin.  The
+    # payload is npz + JSON, loaded with allow_pickle=False: a cache file
+    # is DATA, never executable (unlike pickle).
+    BINARY_TOKEN = b"lightgbm_tpu.dataset.v2\n"
+
     def save_binary(self, filename: str) -> "Dataset":
-        """Binary dataset cache (Dataset::SaveBinaryFile analogue, npz based)."""
+        """Binary dataset cache (Dataset::SaveBinaryFile analogue)."""
+        import io
+        import json
         c = self.constructed
-        import pickle
+        mappers = [{
+            "num_bin": int(m.num_bin), "bin_type": int(m.bin_type),
+            "missing_type": int(m.missing_type),
+            "is_trivial": bool(m.is_trivial),
+            "bin_upper_bound": (None if m.bin_upper_bound is None
+                                else [float(x) for x in m.bin_upper_bound]),
+            "categorical_2_bin": (None if m.categorical_2_bin is None
+                                  else {str(k): int(v) for k, v
+                                        in m.categorical_2_bin.items()}),
+            "bin_2_categorical": (None if m.bin_2_categorical is None
+                                  else [int(x) for x in m.bin_2_categorical]),
+            "min_val": float(m.min_val), "max_val": float(m.max_val),
+            "default_bin": int(m.default_bin),
+        } for m in c.bin_mappers]
+        meta = {
+            "mappers": mappers,
+            "feature_names": list(c.feature_names or []),
+            "num_total_features": int(c.num_total_features),
+            "used_features": [int(x) for x in c.used_features],
+            "bundles": (None if c.layout is None
+                        else [[int(j) for j in b] for b in c.layout.bundles]),
+        }
+        arrays = {"binned": np.asarray(c.binned),
+                  "meta_json": np.frombuffer(
+                      json.dumps(meta).encode(), dtype=np.uint8).copy()}
+        for key, val in (("label", c.metadata.label),
+                         ("weight", c.metadata.weight),
+                         ("query_boundaries", c.metadata.query_boundaries),
+                         ("init_score", c.metadata.init_score)):
+            if val is not None:
+                arrays[key] = np.asarray(val)
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **arrays)
         with open(filename, "wb") as f:
-            pickle.dump({
-                "binned": c.binned, "used_features": c.used_features,
-                "bin_mappers": c.bin_mappers, "feature_names": c.feature_names,
-                "num_total_features": c.num_total_features,
-                "label": c.metadata.label, "weight": c.metadata.weight,
-                "query_boundaries": c.metadata.query_boundaries,
-                "init_score": c.metadata.init_score}, f)
+            f.write(Dataset.BINARY_TOKEN)
+            f.write(buf.getvalue())
         return self
 
     @staticmethod
-    def load_binary(filename: str) -> "Dataset":
-        import pickle
+    def _is_binary_cache(filename: str) -> bool:
+        try:
+            with open(filename, "rb") as f:
+                return f.read(len(Dataset.BINARY_TOKEN)) == \
+                    Dataset.BINARY_TOKEN
+        except OSError:
+            return False
+
+    @staticmethod
+    def _load_binary_training_data(filename: str) -> TrainingData:
+        import io
+        import json
+        from .data.binning import BinMapper
+        from .data.bundling import BundleLayout
         with open(filename, "rb") as f:
-            state = pickle.load(f)
-        ds = Dataset(None)
+            head = f.read(len(Dataset.BINARY_TOKEN))
+            if head != Dataset.BINARY_TOKEN:
+                raise ValueError(f"{filename} is not a lightgbm_tpu binary "
+                                 "dataset cache")
+            npz = np.load(io.BytesIO(f.read()), allow_pickle=False)
+        meta = json.loads(bytes(npz["meta_json"]).decode())
         td = TrainingData()
-        td.binned = state["binned"]
-        td.used_features = state["used_features"]
-        td.bin_mappers = state["bin_mappers"]
-        td.feature_names = state["feature_names"]
-        td.num_total_features = state["num_total_features"]
-        td.num_data = len(state["binned"])
+        td.binned = npz["binned"]
+        td.used_features = list(meta["used_features"])
+        td.feature_names = meta["feature_names"]
+        td.num_total_features = meta["num_total_features"]
+        td.num_data = len(td.binned)
+        td.bin_mappers = []
+        for d in meta["mappers"]:
+            m = BinMapper()
+            m.num_bin = d["num_bin"]
+            m.bin_type = d["bin_type"]
+            m.missing_type = d["missing_type"]
+            m.is_trivial = d["is_trivial"]
+            m.bin_upper_bound = (None if d["bin_upper_bound"] is None else
+                                 np.asarray(d["bin_upper_bound"], np.float64))
+            m.categorical_2_bin = (None if d["categorical_2_bin"] is None
+                                   else {int(k): v for k, v
+                                         in d["categorical_2_bin"].items()})
+            m.bin_2_categorical = d["bin_2_categorical"]
+            m.min_val = d["min_val"]
+            m.max_val = d["max_val"]
+            m.default_bin = d["default_bin"]
+            td.bin_mappers.append(m)
+        if meta.get("bundles") is not None:
+            td.layout = BundleLayout(meta["bundles"], td.bin_mappers,
+                                     td.used_features)
         td.metadata = data_mod.Metadata(td.num_data)
-        td.metadata.set_label(state["label"])
-        td.metadata.set_weight(state["weight"])
-        td.metadata.query_boundaries = state["query_boundaries"]
-        td.metadata.set_init_score(state["init_score"])
-        ds._constructed = td
+        td.metadata.set_label(npz["label"] if "label" in npz else None)
+        td.metadata.set_weight(npz["weight"] if "weight" in npz else None)
+        td.metadata.query_boundaries = (npz["query_boundaries"]
+                                        if "query_boundaries" in npz else None)
+        td.metadata.set_init_score(npz["init_score"]
+                                   if "init_score" in npz else None)
+        return td
+
+    @staticmethod
+    def load_binary(filename: str) -> "Dataset":
+        ds = Dataset(None)
+        ds._constructed = Dataset._load_binary_training_data(filename)
         return ds
 
 

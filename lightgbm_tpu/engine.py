@@ -55,7 +55,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # continued training: load old model, use it as init scores
         prev = init_model if isinstance(init_model, Booster) \
             else Booster(model_file=str(init_model), params=params)
-        raw = train_set.raw if train_set.raw is not None else train_set.data
+        raw = train_set.ensure_raw()
         if raw is None:
             log.fatal("Continued training requires raw data "
                       "(set free_raw_data=False)")
@@ -227,7 +227,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
         stratified = False if params.get("objective") else stratified
 
     train_set.construct()
-    raw = train_set.raw
+    raw = train_set.ensure_raw()
     if raw is None:
         log.fatal("cv requires raw data (set free_raw_data=False)")
     label = train_set.get_label()

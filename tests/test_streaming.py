@@ -103,3 +103,63 @@ def test_streamed_header_and_categorical(tmp_path):
     from lightgbm_tpu.data.binning import BIN_TYPE_CATEGORICAL
     assert ds.bin_mappers[0].bin_type == BIN_TYPE_CATEGORICAL
     assert ds.bin_mappers[1].bin_type != BIN_TYPE_CATEGORICAL
+
+
+def test_binary_cache_auto_load(tmp_path):
+    """CheckCanLoadFromBin parity (dataset_loader.cpp:980-1018):
+    save_binary=true writes '<data>.bin' during construction, and later
+    loads prefer that cache over re-parsing the text — proven by
+    corrupting the text file and still training the identical model.
+    Pointing data= directly at a cache file also works."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(5)
+    n = 800
+    X = rng.randn(n, 5)
+    y = ((X @ rng.randn(5)) > 0).astype(np.float64)
+    path = tmp_path / "train.tsv"
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=10,
+                  verbose=-1, save_binary=True)
+    m1 = lgb.train(params, lgb.Dataset(str(path), params=params),
+                   num_boost_round=5).model_to_string()
+    bin_path = tmp_path / "train.tsv.bin"
+    assert bin_path.exists()
+
+    path.write_text("garbage that would fail parsing\n")
+    params2 = dict(objective="binary", num_leaves=7, min_data_in_leaf=10,
+                   verbose=-1)
+    m2 = lgb.train(params2, lgb.Dataset(str(path), params=params2),
+                   num_boost_round=5).model_to_string()
+    assert m2 == m1, "binary cache was not used"
+
+    m3 = lgb.train(params2, lgb.Dataset(str(bin_path), params=params2),
+                   num_boost_round=5).model_to_string()
+    assert m3 == m1
+
+
+def test_binary_cache_preserves_bundles(tmp_path):
+    """The cache must round-trip the EFB layout: a bundled dataset
+    reloaded from cache trains the identical model (the layout maps
+    physical columns back to logical features)."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(2)
+    n, groups, width = 1500, 6, 5
+    X = np.zeros((n, groups * width))
+    hot = rng.randint(0, width + 1, size=(n, groups))
+    for g in range(groups):
+        sel = hot[:, g] < width
+        X[np.flatnonzero(sel), g * width + hot[sel, g]] = 1.0
+    y = ((hot[:, 0] == 1) | (hot[:, 2] == 3)).astype(np.float64)
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=10,
+                  verbose=-1, enable_bundle=True)
+    d1 = lgb.Dataset(X, label=y, params=params)
+    m1 = lgb.train(params, d1, num_boost_round=5).model_to_string()
+    assert d1.constructed.layout is not None      # bundling engaged
+
+    cache = tmp_path / "bundled.bin"
+    d1.save_binary(str(cache))
+    d2 = lgb.Dataset.load_binary(str(cache))
+    assert d2.constructed.layout is not None
+    m2 = lgb.train(params, d2, num_boost_round=5).model_to_string()
+    assert m2 == m1
