@@ -30,6 +30,49 @@ def _to_matrix(data) -> np.ndarray:
     return arr
 
 
+def _data_from_pandas(data, pandas_categorical):
+    """Convert a DataFrame's ``category`` columns to their integer codes
+    (reference basic.py:225-263 _data_from_pandas).  On the train dataset
+    ``pandas_categorical`` is None and the category levels are recorded;
+    on valid/predict data the recorded levels re-align the codes so the
+    same string maps to the same code everywhere.
+
+    Returns (float_matrix, cat_col_names, pandas_categorical)."""
+    cat_cols = [c for c in data.columns
+                if str(data[c].dtype) == "category"]
+    if pandas_categorical is None:
+        pandas_categorical = [list(data[c].cat.categories) for c in cat_cols]
+    else:
+        if len(cat_cols) != len(pandas_categorical):
+            raise ValueError("train and valid dataset categorical_feature "
+                             "do not match.")
+        data = data.copy()
+        for col, cats in zip(cat_cols, pandas_categorical):
+            if list(data[col].cat.categories) != list(cats):
+                data[col] = data[col].cat.set_categories(cats)
+    if cat_cols:
+        data = data.copy()
+        for c in cat_cols:
+            # code -1 means NaN or a level outside the train categories —
+            # route it through the missing-value path, not as a phantom
+            # category (reference _data_from_pandas replace({-1: nan}))
+            codes = data[c].cat.codes.to_numpy().astype(np.float64)
+            codes[codes == -1] = np.nan
+            data[c] = codes
+    return (np.asarray(data.values, dtype=np.float64), cat_cols,
+            pandas_categorical)
+
+
+def _load_pandas_categorical(model_str: str):
+    """Last-line ``pandas_categorical:<json>`` of a model file
+    (reference basic.py:277-289)."""
+    import json
+    last = model_str.rstrip().rsplit("\n", 1)[-1]
+    if last.startswith("pandas_categorical:"):
+        return json.loads(last[len("pandas_categorical:"):])
+    return None
+
+
 class Dataset:
     """Lazily-constructed training dataset (basic.py:548+ semantics)."""
 
@@ -51,6 +94,7 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._constructed: Optional[TrainingData] = None
         self.raw: Optional[np.ndarray] = None
+        self.pandas_categorical: Optional[List[List]] = None
 
     # -- lazy construction --------------------------------------------------
 
@@ -138,7 +182,18 @@ class Dataset:
                              candidate)
                     self._constructed = \
                         self._load_binary_training_data(candidate)
-                    self.label = self._constructed.metadata.label
+                    # user-supplied fields override the cached metadata
+                    # (reference binary load + set_field flow)
+                    if self.label is not None:
+                        self.set_label(self.label)
+                    else:
+                        self.label = self._constructed.metadata.label
+                    if self.weight is not None:
+                        self.set_weight(self.weight)
+                    if self.group is not None:
+                        self.set_group(self.group)
+                    if self.init_score is not None:
+                        self.set_init_score(self.init_score)
                     self._loaded_from_file = True
                     self._dist_sharded = False
                     return self
@@ -184,6 +239,7 @@ class Dataset:
             if self.free_raw_data:
                 self.data = None
             return self
+        pd_cat_cols: List = []   # pandas category-dtype columns, by name
         if isinstance(self.data, (str, os.PathLike)):
             path = str(self.data)
             feats, labels, names = load_text_file(
@@ -227,6 +283,15 @@ class Dataset:
                         init = init[sel]
                     self.init_score = init
                 # self.group was already partitioned by query unit
+        elif hasattr(self.data, "columns") and hasattr(self.data, "dtypes"):
+            # pandas: category-dtype columns become their codes, with the
+            # train dataset's category levels re-aligning valid data
+            # (reference _data_from_pandas)
+            ref_pc = (self.reference.pandas_categorical
+                      if self.reference is not None
+                      else self.pandas_categorical)
+            mat, pd_cat_cols, self.pandas_categorical = \
+                _data_from_pandas(self.data, ref_pc)
         else:
             mat = _to_matrix(self.data)
 
@@ -238,10 +303,15 @@ class Dataset:
             cols = [str(c) for c in self.data.columns]
             if names is None:
                 names = cols
-            if self.categorical_feature not in ("auto", None):
-                for c in self.categorical_feature:
-                    cat_idx.append(cols.index(c) if isinstance(c, str)
-                                   else int(c))
+            explicit = (list(self.categorical_feature)
+                        if self.categorical_feature not in ("auto", None)
+                        else [])
+            # category-dtype columns are categorical features regardless
+            # of the explicit list (reference basic.py:241-247)
+            for c in explicit + [str(c) for c in pd_cat_cols]:
+                idx = cols.index(c) if isinstance(c, str) else int(c)
+                if idx not in cat_idx:
+                    cat_idx.append(idx)
         elif isinstance(self.categorical_feature, (list, tuple)):
             for c in self.categorical_feature:
                 if isinstance(c, str) and names and c in names:
@@ -413,7 +483,7 @@ class Dataset:
         (reference Dataset.subset; requires raw data retained in memory)."""
         self.construct()
         raw = self.ensure_raw()
-        if raw is None or isinstance(raw, (str, os.PathLike)):
+        if raw is None:
             log.fatal("Cannot subset: raw data not in memory (construct "
                       "with free_raw_data=False from an in-memory matrix)")
         idx = np.asarray(used_indices, dtype=np.int64)
@@ -568,20 +638,25 @@ class Booster:
         self.best_iteration = -1
         self.best_score: Dict = {}
         self._train_dataset = train_set
+        self.pandas_categorical: Optional[List[List]] = None
         if train_set is not None:
             cfg = config_from_params(self.params)
             log.set_verbosity(cfg.verbose)
             train_set.construct(cfg)
+            self.pandas_categorical = train_set.pandas_categorical
             objective = create_objective(cfg)
             self.inner: GBDT = create_boosting(cfg, train_set.constructed,
                                                objective)
         elif model_file is not None:
             with open(model_file) as f:
-                self.inner = GBDT.load_from_string(
-                    f.read(), config_from_params(self.params))
+                content = f.read()
+            self.inner = GBDT.load_from_string(
+                content, config_from_params(self.params))
+            self.pandas_categorical = _load_pandas_categorical(content)
         elif model_str is not None:
             self.inner = GBDT.load_from_string(
                 model_str, config_from_params(self.params))
+            self.pandas_categorical = _load_pandas_categorical(model_str)
         else:
             raise ValueError("Booster needs train_set, model_file or model_str")
 
@@ -719,27 +794,52 @@ class Booster:
 
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
                 pred_leaf: bool = False, pred_early_stop: bool = False,
-                **kwargs):
+                pred_parameter: Optional[Dict[str, Any]] = None, **kwargs):
         if isinstance(data, (str, os.PathLike)):
             feats, _, _ = load_text_file(str(data),
                                          has_header=self.inner.config.has_header)
             data = feats
+        elif hasattr(data, "columns") and hasattr(data, "dtypes"):
+            data = _data_from_pandas(data, self.pandas_categorical)[0]
         else:
             data = _to_matrix(data)
         if num_iteration is None or num_iteration <= 0:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
-        return self.inner.predict(data, num_iteration=num_iteration,
-                                  raw_score=raw_score, pred_leaf=pred_leaf,
-                                  pred_early_stop=pred_early_stop)
+        # reference basic.py predict accepts per-call prediction params
+        # (pred_parameter dict); merge with the keyword forms
+        pp = canonicalize_params(pred_parameter or {})
+        pred_early_stop = bool(pp.get("pred_early_stop", pred_early_stop))
+        pred_leaf = bool(pp.get("is_predict_leaf_index", pred_leaf))
+        raw_score = bool(pp.get("is_predict_raw_score", raw_score))
+        es_freq = pp.get("pred_early_stop_freq")
+        es_margin = pp.get("pred_early_stop_margin")
+        return self.inner.predict(
+            data, num_iteration=num_iteration, raw_score=raw_score,
+            pred_leaf=pred_leaf, pred_early_stop=pred_early_stop,
+            pred_early_stop_freq=None if es_freq is None else int(es_freq),
+            pred_early_stop_margin=(None if es_margin is None
+                                    else float(es_margin)))
 
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
         if num_iteration is None or num_iteration <= 0:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
         self.inner.save_model(filename, num_iteration)
+        if self.pandas_categorical:
+            # trailing mapping line, ignored by model parsers (reference
+            # _save_pandas_categorical)
+            import json
+            with open(filename, "a") as f:
+                f.write("\npandas_categorical:"
+                        + json.dumps(self.pandas_categorical) + "\n")
         return self
 
     def model_to_string(self, num_iteration: int = -1) -> str:
-        return self.inner.save_model_to_string(num_iteration)
+        s = self.inner.save_model_to_string(num_iteration)
+        if self.pandas_categorical:
+            import json
+            s += ("\npandas_categorical:"
+                  + json.dumps(self.pandas_categorical) + "\n")
+        return s
 
     def dump_model(self, num_iteration: int = -1) -> Dict:
         """JSON model dump (gbdt.cpp DumpModel)."""

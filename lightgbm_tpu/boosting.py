@@ -603,23 +603,33 @@ class GBDT:
     # ---------------------------------------------------------------- predict
 
     def predictor(self, num_iteration: int = -1, raw_score: bool = False,
-                  pred_early_stop: bool = False) -> Predictor:
+                  pred_early_stop: bool = False,
+                  pred_early_stop_freq: Optional[int] = None,
+                  pred_early_stop_margin: Optional[float] = None) -> Predictor:
         return Predictor(self.models, self.num_class, self.objective,
                          average_output=self.average_output,
                          num_iteration=(num_iteration + (1 if (
                              self.boost_from_average_ and num_iteration > 0)
                              else 0)) if num_iteration > 0 else -1,
                          early_stop=pred_early_stop,
-                         early_stop_freq=self.config.pred_early_stop_freq,
-                         early_stop_margin=self.config.pred_early_stop_margin)
+                         early_stop_freq=(
+                             pred_early_stop_freq if pred_early_stop_freq
+                             is not None else self.config.pred_early_stop_freq),
+                         early_stop_margin=(
+                             pred_early_stop_margin if pred_early_stop_margin
+                             is not None
+                             else self.config.pred_early_stop_margin))
 
     def predict(self, X, num_iteration: int = -1, raw_score: bool = False,
-                pred_leaf: bool = False, pred_early_stop: bool = False):
+                pred_leaf: bool = False, pred_early_stop: bool = False,
+                pred_early_stop_freq: Optional[int] = None,
+                pred_early_stop_margin: Optional[float] = None):
         if not pred_leaf and not pred_early_stop:
             out = self._native_predict(X, num_iteration, raw_score)
             if out is not None:
                 return out
-        p = self.predictor(num_iteration, raw_score, pred_early_stop)
+        p = self.predictor(num_iteration, raw_score, pred_early_stop,
+                           pred_early_stop_freq, pred_early_stop_margin)
         if pred_leaf:
             return p.predict_leaf_index(X)
         return p.predict(X, raw_score=raw_score)

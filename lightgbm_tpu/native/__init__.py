@@ -228,6 +228,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.GBTN_BoosterPredictForCSR.argtypes = [
             c_p, c_i_p, c_ll, c_i_p, c_d_p, c_ll, c_ll, c_i, c_i, c_ll,
             c_ll_p, c_d_p]
+        lib.GBTN_BoosterPredictForCSC.restype = c_i
+        lib.GBTN_BoosterPredictForCSC.argtypes = [
+            c_p, c_i_p, c_ll, c_i_p, c_d_p, c_ll, c_ll, c_i, c_i, c_ll,
+            c_ll_p, c_d_p]
         lib.GBTN_BoosterPredictForFile.restype = c_i
         lib.GBTN_BoosterPredictForFile.argtypes = [c_p, c_c_p, c_i, c_c_p,
                                                    c_i, c_i]

@@ -431,6 +431,26 @@ def booster_predict_csr_into(bst, mv_indptr, nindptr, mv_indices, mv_data,
     return int(flat.size)
 
 
+def booster_predict_csc_into(bst, mv_colptr, ncolptr, mv_indices, mv_data,
+                             nelem, nrow, predict_type, num_iteration,
+                             mv_out, out_capacity) -> int:
+    colptr = np.frombuffer(mv_colptr, dtype=np.int32, count=ncolptr)
+    indices = np.frombuffer(mv_indices, dtype=np.int32, count=nelem)
+    data = np.frombuffer(mv_data, dtype=np.float64, count=nelem)
+    ncol = ncolptr - 1
+    X = np.zeros((nrow, ncol), dtype=np.float64)
+    col_of = np.repeat(np.arange(ncol), np.diff(colptr).astype(np.int64))
+    X[indices, col_of] = data
+    pred = _predict_array(bst, X, predict_type, num_iteration)
+    flat = pred.reshape(-1)
+    if flat.size > out_capacity:
+        raise ValueError(f"output buffer too small: need {flat.size}, "
+                         f"have {out_capacity}")
+    out = np.frombuffer(mv_out, dtype=np.float64, count=flat.size)
+    out[:] = flat
+    return int(flat.size)
+
+
 def booster_predict_for_file(bst, data_filename, has_header,
                              result_filename, predict_type,
                              num_iteration) -> bool:

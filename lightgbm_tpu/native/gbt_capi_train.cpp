@@ -839,6 +839,31 @@ int GBTN_BoosterPredictForCSR(void* booster, const int* indptr,
   return 0;
 }
 
+int GBTN_BoosterPredictForCSC(void* booster, const int* colptr,
+                              long long ncolptr, const int* indices,
+                              const double* data, long long nelem,
+                              long long nrow, int predict_type,
+                              int num_iteration, long long out_capacity,
+                              long long* out_len, double* out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* mv_p = mv_read(colptr, ncolptr * sizeof(int));
+  PyObject* mv_i = mv_read(indices, nelem * sizeof(int));
+  PyObject* mv_d = mv_read(data, nelem * sizeof(double));
+  PyObject* mv_out = mv_write(out, out_capacity * sizeof(double));
+  PyObject* args = Py_BuildValue(
+      "(OOLOOLLiiOL)", handle_or_none(booster), mv_p, ncolptr, mv_i, mv_d,
+      nelem, nrow, predict_type, num_iteration, mv_out, out_capacity);
+  Py_XDECREF(mv_p);
+  Py_XDECREF(mv_i);
+  Py_XDECREF(mv_d);
+  Py_XDECREF(mv_out);
+  long long written = 0;
+  if (bridge_ll("booster_predict_csc_into", args, &written) != 0) return -1;
+  if (out_len != nullptr) *out_len = written;
+  return 0;
+}
+
 int GBTN_BoosterPredictForFile(void* booster, const char* data_filename,
                                int has_header, const char* result_filename,
                                int predict_type, int num_iteration) {

@@ -370,13 +370,23 @@ def test_capi_booster_lifecycle(tmp_path):
                                 ctypes.byref(out_cnt), _dp(prob)))
     np.testing.assert_allclose(prob, 1.0 / (1.0 + np.exp(-raw)), rtol=1e-6)
 
-    # CSR predict parity with dense
+    # CSR / CSC predict parity with dense
     indptr, indices, data = _to_csr(X)
     prob_csr = np.zeros(n, dtype=np.float64)
     _ok(lib.GBTN_BoosterPredictForCSR(
         bst, _ip(indptr), len(indptr), _ip(indices), _dp(data), len(data),
         f, 0, -1, n, ctypes.byref(out_cnt), _dp(prob_csr)))
     np.testing.assert_allclose(prob_csr, prob, rtol=1e-12)
+    maskc = X != 0.0
+    colptr = np.zeros(f + 1, dtype=np.int32)
+    colptr[1:] = np.cumsum(maskc.sum(axis=0))
+    crow = np.ascontiguousarray(np.nonzero(maskc.T)[1].astype(np.int32))
+    cval = np.ascontiguousarray(X.T[maskc.T], dtype=np.float64)
+    prob_csc = np.zeros(n, dtype=np.float64)
+    _ok(lib.GBTN_BoosterPredictForCSC(
+        bst, _ip(colptr), len(colptr), _ip(crow), _dp(cval), len(cval),
+        n, 0, -1, n, ctypes.byref(out_cnt), _dp(prob_csc)))
+    np.testing.assert_allclose(prob_csc, prob, rtol=1e-12)
 
     # custom-gradient update == plain update on binary logloss
     need = ctypes.c_longlong(0)

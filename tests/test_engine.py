@@ -174,3 +174,189 @@ def test_cv_and_cvbooster(binary_example):
                             num_boost_round=2))
     preds = cb.predict(X[:10])              # dispatches to every fold
     assert len(preds) == 2 and len(preds[0]) == 10
+
+
+def test_missing_value_handle_na():
+    """reference test_engine.py:125-152: with NaN-as-missing, a 2-leaf
+    1-round tree at lr=1 must route the NaN row to its own side and
+    reproduce the labels exactly."""
+    x = [0, 1, 2, 3, 4, 5, 6, 7, np.nan]
+    y = [1, 1, 1, 1, 0, 0, 0, 0, 1]
+    X_train = np.array(x).reshape(len(x), 1)
+    y_train = np.array(y, dtype=np.float64)
+    params = {"objective": "regression", "verbose": -1,
+              "boost_from_average": False, "min_data": 1, "num_leaves": 2,
+              "learning_rate": 1, "min_data_in_bin": 1,
+              "zero_as_missing": False}
+    gbm = lgb.train(params, lgb.Dataset(X_train, label=y_train),
+                    num_boost_round=1)
+    np.testing.assert_almost_equal(gbm.predict(X_train), y)
+
+
+def test_missing_value_handle_zero():
+    """reference test_engine.py:154-181: zero_as_missing=True routes both
+    the 0.0 row and the NaN row to the default side."""
+    x = [0, 1, 2, 3, 4, 5, 6, 7, np.nan]
+    y = [0, 1, 1, 1, 0, 0, 0, 0, 0]
+    X_train = np.array(x).reshape(len(x), 1)
+    y_train = np.array(y, dtype=np.float64)
+    params = {"objective": "regression", "verbose": -1,
+              "boost_from_average": False, "min_data": 1, "num_leaves": 2,
+              "learning_rate": 1, "min_data_in_bin": 1,
+              "zero_as_missing": True}
+    gbm = lgb.train(params, lgb.Dataset(X_train, label=y_train),
+                    num_boost_round=1)
+    np.testing.assert_almost_equal(gbm.predict(X_train), y)
+
+
+def test_missing_value_handle_none():
+    """reference test_engine.py:183-212: use_missing=False folds NaN to
+    0.0, so the NaN row predicts like the 0 row."""
+    x = [0, 1, 2, 3, 4, 5, 6, 7, np.nan]
+    y = [0, 1, 1, 1, 0, 0, 0, 0, 0]
+    X_train = np.array(x).reshape(len(x), 1)
+    y_train = np.array(y, dtype=np.float64)
+    params = {"objective": "regression", "verbose": -1,
+              "boost_from_average": False, "min_data": 1, "num_leaves": 2,
+              "learning_rate": 1, "min_data_in_bin": 1,
+              "use_missing": False}
+    gbm = lgb.train(params, lgb.Dataset(X_train, label=y_train),
+                    num_boost_round=1)
+    pred = gbm.predict(X_train)
+    np.testing.assert_almost_equal(pred[0], pred[1], decimal=5)
+    np.testing.assert_almost_equal(pred[-1], pred[0], decimal=5)
+
+
+def test_multiclass_prediction_early_stopping():
+    """reference test_engine.py:264-289: a small margin stops tree
+    traversal early (worse loss), a large margin matches the full model."""
+    rng = np.random.RandomState(13)
+    n, f, k = 2000, 10, 4
+    X = rng.randn(n, f)
+    centers = rng.randn(k, f) * 2.0
+    y = np.argmin(((X[:, None, :] - centers[None]) ** 2).sum(-1), axis=1)
+    params = {"objective": "multiclass", "metric": "multi_logloss",
+              "num_class": k, "verbose": -1}
+    cut = n - 200
+    d = lgb.Dataset(X[:cut], label=y[:cut].astype(np.float64))
+    gbm = lgb.train(params, d, num_boost_round=50)
+
+    def mlogloss(yt, p):
+        return -np.mean(np.log(np.clip(p[np.arange(len(yt)), yt],
+                                       1e-12, 1.0)))
+
+    Xt, yt = X[cut:], y[cut:]
+    full = mlogloss(yt, np.asarray(gbm.predict(Xt)).reshape(len(Xt), k))
+    tight = mlogloss(yt, np.asarray(gbm.predict(
+        Xt, pred_parameter={"pred_early_stop": True,
+                            "pred_early_stop_freq": 5,
+                            "pred_early_stop_margin": 0.5})
+    ).reshape(len(Xt), k))
+    loose = mlogloss(yt, np.asarray(gbm.predict(
+        Xt, pred_parameter={"pred_early_stop": True,
+                            "pred_early_stop_freq": 5,
+                            "pred_early_stop_margin": 20.0})
+    ).reshape(len(Xt), k))
+    assert tight > full          # stopping early costs accuracy
+    np.testing.assert_allclose(loose, full, rtol=1e-6)
+
+
+def test_continue_train_and_dump_model(tmp_path):
+    """reference test_engine.py:322-352: continued training from a saved
+    model file, custom feval tracking the builtin metric, dump_model."""
+    rng = np.random.RandomState(7)
+    n, f = 2000, 10
+    X = rng.randn(n, f)
+    y = X @ rng.randn(f) + 0.3 * rng.randn(n)
+    cut = n - 200
+    params = {"objective": "regression", "metric": "l1", "verbose": -1}
+    d = lgb.Dataset(X[:cut], label=y[:cut], free_raw_data=False)
+    dv = lgb.Dataset(X[cut:], label=y[cut:], reference=d,
+                     free_raw_data=False)
+    init_gbm = lgb.train(params, d, num_boost_round=20)
+    model_name = str(tmp_path / "model.txt")
+    init_gbm.save_model(model_name)
+    evals_result = {}
+    gbm = lgb.train(params, d, num_boost_round=30, valid_sets=[dv],
+                    feval=(lambda p, ds: ("mae", float(np.mean(np.abs(
+                        p - ds.get_label()))), False)),
+                    callbacks=[lgb.record_evaluation(evals_result)],
+                    init_model=model_name)
+    ret = float(np.mean(np.abs(y[cut:] - gbm.predict(X[cut:]))))
+    np.testing.assert_almost_equal(evals_result["valid_0"]["l1"][-1], ret,
+                                   decimal=5)
+    for l1, mae in zip(evals_result["valid_0"]["l1"],
+                       evals_result["valid_0"]["mae"]):
+        np.testing.assert_almost_equal(l1, mae, decimal=5)
+    assert "tree_info" in gbm.dump_model()
+    assert isinstance(gbm.feature_importance(), np.ndarray)
+
+
+def test_continue_train_multiclass():
+    """reference test_engine.py:354-376: multiclass continued training
+    from an in-memory booster."""
+    rng = np.random.RandomState(21)
+    n, f, k = 1500, 8, 3
+    X = rng.randn(n, f)
+    centers = rng.randn(k, f) * 2.0
+    y = np.argmin(((X[:, None, :] - centers[None]) ** 2).sum(-1),
+                  axis=1).astype(np.float64)
+    cut = n - 150
+    params = {"objective": "multiclass", "metric": "multi_logloss",
+              "num_class": k, "verbose": -1}
+    d = lgb.Dataset(X[:cut], label=y[:cut], params=params,
+                    free_raw_data=False)
+    dv = lgb.Dataset(X[cut:], label=y[cut:], reference=d, params=params,
+                     free_raw_data=False)
+    init_gbm = lgb.train(params, d, num_boost_round=10)
+    evals_result = {}
+    gbm = lgb.train(params, d, num_boost_round=10, valid_sets=[dv],
+                    callbacks=[lgb.record_evaluation(evals_result)],
+                    init_model=init_gbm)
+    pred = np.asarray(gbm.predict(X[cut:])).reshape(-1, k)
+    yt = y[cut:].astype(int)
+    mll = -np.mean(np.log(np.clip(pred[np.arange(len(yt)), yt],
+                                  1e-12, 1.0)))
+    assert mll < 1.0
+    np.testing.assert_almost_equal(
+        evals_result["valid_0"]["multi_logloss"][-1], mll, decimal=5)
+
+
+def test_pandas_categorical(tmp_path):
+    """reference test_engine.py:446-486: category-dtype DataFrame columns
+    auto-convert to codes; explicit categorical_feature lists are
+    equivalent; the category mapping survives a model file round trip and
+    re-aligns unseen test categories."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(42)
+    X = pd.DataFrame({
+        "A": rng.permutation(["a", "b", "c", "d"] * 75),           # str
+        "B": rng.permutation([1, 2, 3] * 100),                     # int
+        "C": rng.permutation([0.1, 0.2, -0.1, -0.1, 0.2] * 60),    # float
+        "D": rng.permutation([True, False] * 150)})                # bool
+    y = rng.permutation([0, 1] * 150).astype(np.float64)
+    X_test = pd.DataFrame({
+        "A": rng.permutation(["a", "b", "e"] * 20),
+        "B": rng.permutation([1, 3] * 30),
+        "C": rng.permutation([0.1, -0.1, 0.2, 0.2] * 15),
+        "D": rng.permutation([True, False] * 30)})
+    for col in ["A", "B", "C", "D"]:
+        X[col] = X[col].astype("category")
+        X_test[col] = X_test[col].astype("category")
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 7, "min_data_in_leaf": 10}
+
+    gbm0 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    pred0 = np.asarray(gbm0.predict(X_test))
+    assert np.std(pred0) > 0
+    gbm3 = lgb.train(params, lgb.Dataset(
+        X, label=y, categorical_feature=["A", "B", "C", "D"]),
+        num_boost_round=10)
+    pred3 = np.asarray(gbm3.predict(X_test))
+    np.testing.assert_almost_equal(pred0, pred3)
+
+    model_path = str(tmp_path / "categorical.model")
+    gbm3.save_model(model_path)
+    gbm4 = lgb.Booster(model_file=model_path)
+    pred4 = np.asarray(gbm4.predict(X_test))
+    np.testing.assert_almost_equal(pred0, pred4)

@@ -163,3 +163,27 @@ def test_binary_cache_preserves_bundles(tmp_path):
     assert d2.constructed.layout is not None
     m2 = lgb.train(params, d2, num_boost_round=5).model_to_string()
     assert m2 == m1
+
+
+def test_binary_cache_user_fields_override(tmp_path):
+    """User-supplied label/weight/group/init_score must override the
+    cached metadata when a dataset is loaded from the '<data>.bin'
+    cache (reference binary load + set_field flow)."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(8)
+    n = 400
+    X = rng.randn(n, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    path = tmp_path / "t.tsv"
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    d0 = lgb.Dataset(str(path), params={"save_binary": True, "verbose": -1})
+    d0.construct()
+    assert (tmp_path / "t.tsv.bin").exists()
+
+    w = np.linspace(1, 2, n).astype(np.float32)
+    y2 = 1.0 - y
+    d1 = lgb.Dataset(str(path), label=y2, weight=w,
+                     params={"verbose": -1})
+    d1.construct()
+    np.testing.assert_allclose(d1.get_weight(), w)
+    np.testing.assert_allclose(d1.get_label(), y2)
