@@ -1,0 +1,44 @@
+#!/bin/bash
+# One-shot TPU evidence capture: run the moment the axon tunnel is alive.
+# Orders the work so the most valuable artifact (a BENCH number) lands
+# first — the tunnel has died mid-session twice; assume it can again.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+OUT=docs/tpu_capture_${STAMP}
+mkdir -p "$OUT"
+
+echo "== probe ==" | tee "$OUT/log.txt"
+if ! timeout 120 python -c "import jax; print(jax.devices())" \
+        >> "$OUT/log.txt" 2>&1; then
+    echo "TPU unreachable; aborting capture" | tee -a "$OUT/log.txt"
+    exit 1
+fi
+
+echo "== bench 1M (tpu+pallas) ==" | tee -a "$OUT/log.txt"
+BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
+    > "$OUT/bench_1m.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m.json" | tee -a "$OUT/log.txt"
+
+echo "== on-chip test tier ==" | tee -a "$OUT/log.txt"
+LGBM_TPU_TESTS_ON_TPU=1 timeout 900 python -m pytest tests/test_tpu.py -q \
+    >> "$OUT/log.txt" 2>&1
+tail -2 "$OUT/log.txt"
+
+echo "== bench wide (Epsilon-shaped 200k x 2000) ==" | tee -a "$OUT/log.txt"
+BENCH_ROWS=200000 BENCH_FEATURES=2000 BENCH_TREES=5 \
+    BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
+    > "$OUT/bench_wide.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_wide.json" | tee -a "$OUT/log.txt"
+
+echo "== bench sparse (EFB) ==" | tee -a "$OUT/log.txt"
+BENCH_SPARSITY=0.9 BENCH_FEATURES=100 BENCH_TREES=5 \
+    BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
+    > "$OUT/bench_sparse.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_sparse.json" | tee -a "$OUT/log.txt"
+
+echo "== profile sweep ==" | tee -a "$OUT/log.txt"
+timeout 1800 python scripts/tpu_profile.py 1000000 \
+    >> "$OUT/log.txt" 2>&1
+
+echo "capture complete: $OUT" | tee -a "$OUT/log.txt"
