@@ -118,7 +118,9 @@ class GBDT:
             lambda_l2=cfg.lambda_l2,
             min_gain_to_split=cfg.min_gain_to_split,
             max_bin=train.max_num_bin(),
-            hist_method=("pallas" if cfg.use_pallas and _on_tpu() else "einsum"),
+            hist_method=("pallas" if cfg.use_pallas and _on_tpu()
+                         else "einsum" if _on_tpu()   # MXU-friendly debug
+                         else cfg.cpu_hist_method),   # scatter-add on CPU
             feat_tile=cfg.pallas_feat_tile,
             row_tile=cfg.pallas_row_tile,
             bucket_min_log2=cfg.pallas_bucket_min_log2,

@@ -220,7 +220,8 @@ class Config:
     gpu_device_id: int = -1
     gpu_use_dp: bool = False
     hist_dtype: str = "float32"    # accumulator dtype for histograms
-    use_pallas: bool = True        # Pallas hist kernel on TPU; einsum otherwise
+    use_pallas: bool = True        # Pallas hist kernel on TPU
+    cpu_hist_method: str = "segment"   # off-TPU histogram: segment | einsum
     pallas_feat_tile: int = 8      # kernel grid: features per block
     pallas_row_tile: int = 512     # kernel grid: rows per block
     pallas_bucket_min_log2: int = 10   # smallest pow2 gather bucket

@@ -52,7 +52,7 @@ class GrowerConfig(NamedTuple):
     lambda_l2: float = 0.0
     min_gain_to_split: float = 0.0
     max_bin: int = 256               # B: histogram width (max over features)
-    hist_method: str = "auto"        # pallas | einsum | auto
+    hist_method: str = "auto"        # pallas | einsum | segment | auto
     feat_tile: int = 8               # Pallas grid: features per block
     row_tile: int = 512              # Pallas grid: rows per block
     bucket_min_log2: int = 10        # smallest pow2 gather-buffer bucket

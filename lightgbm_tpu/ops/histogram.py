@@ -9,9 +9,14 @@ split through its leaf-contiguous ``order`` array (the reference's
 smaller-child trick, ``serial_tree_learner.cpp:326-404``), so the work per
 split is proportional to the smaller child, not to the dataset:
 
-* ``subset_histogram_einsum`` — chunked f32 one-hot einsum; CPU / parity path.
 * ``pallas_hist.subset_histogram_pallas`` — bf16 MXU Pallas kernel whose
-  one-hot tile never leaves VMEM; hi/lo-split weights keep ~f32 accuracy.
+  one-hot tile never leaves VMEM; hi/lo-split weights keep ~f32 accuracy
+  (the TPU path).
+* ``subset_histogram_segment`` — one ``segment_sum`` scatter-add over the
+  combined (feature, bin) index; the default CPU path (fallback rungs,
+  test mesh), where scatter lowers well.
+* ``subset_histogram_einsum`` — chunked f32 one-hot einsum; the
+  MXU-shaped debug/parity oracle (``use_pallas=false`` on TPU).
 
 Each histogram entry is ``(sum_gradients, sum_hessians, count)`` exactly like
 the reference ``HistogramBinEntry`` (``include/LightGBM/bin.h:27-56``).
@@ -68,6 +73,26 @@ def subset_histogram_einsum(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     return acc
 
 
+def subset_histogram_segment(rows: jnp.ndarray, g: jnp.ndarray,
+                             h: jnp.ndarray, c: jnp.ndarray,
+                             num_bins: int) -> jnp.ndarray:
+    """Histogram via one scatter-add (``segment_sum``) over the combined
+    (feature, bin) index — O(M·F) adds instead of the einsum's O(M·F·B)
+    MACs.  This IS the reference's dense_bin.hpp:66-132 accumulation in
+    XLA form; scatter lowers well on CPU (where the fallback rungs run)
+    but poorly on TPU, which is exactly why the TPU path is the MXU
+    one-hot contraction instead."""
+    rows = rows.astype(jnp.int32)
+    m, f = rows.shape
+    w = jnp.stack([g, h, c], axis=-1)                    # [M, 3]
+    idx = rows + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
+    vals = jnp.broadcast_to(w[:, None, :], (m, f, NUM_STATS))
+    hist = jax.ops.segment_sum(vals.reshape(-1, NUM_STATS),
+                               idx.reshape(-1),
+                               num_segments=f * num_bins)
+    return hist.reshape(f, num_bins, NUM_STATS)
+
+
 def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                      c: jnp.ndarray, num_bins: int,
                      method: str = "auto", feat_tile: int = 8,
@@ -80,7 +105,7 @@ def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     if method == "auto":
         method = ("pallas"
                   if any(d.platform == "tpu" for d in jax.devices())
-                  else "einsum")
+                  else "segment")
     if method == "pallas":
         from .pallas_hist import subset_histogram_pallas
         return subset_histogram_pallas(rows, g, h, c, num_bins,
@@ -88,4 +113,6 @@ def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                                        row_tile=row_tile)
     if method == "einsum":
         return subset_histogram_einsum(rows, g, h, c, num_bins)
+    if method == "segment":
+        return subset_histogram_segment(rows, g, h, c, num_bins)
     raise ValueError(f"unknown histogram method {method!r}")

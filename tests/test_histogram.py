@@ -48,6 +48,17 @@ def test_einsum_matches_numpy(problem):
     assert out[:, :, 2].sum(axis=1) == pytest.approx(c.sum())
 
 
+def test_segment_matches_numpy(problem):
+    from lightgbm_tpu.ops.histogram import subset_histogram_segment
+    rows, g, h, c, b, real = problem
+    ref = _numpy_reference(rows, g, h, c, b)
+    out = np.asarray(subset_histogram_segment(
+        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+        b))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+    assert out[:, :, 2].sum(axis=1) == pytest.approx(c.sum())
+
+
 def test_pallas_matches_einsum_interpret(problem):
     rows, g, h, c, b, real = problem
     a = np.asarray(subset_histogram_einsum(
