@@ -4,9 +4,12 @@ TPU-native re-design of ``SerialTreeLearner::Train``
 (``src/treelearner/serial_tree_learner.cpp:152-205``):
 
 * the reference's ``DataPartition`` index reordering is kept as-is on device:
-  a position array ``order`` groups rows contiguously by leaf
-  (``data_partition.hpp:94-146``), updated per split by a cumsum-rank
-  scatter (stable partition, all O(N) streaming ops);
+  an index array ``order`` groups rows contiguously by leaf
+  (``data_partition.hpp:94-146``); per split only the SPLITTING leaf's
+  window of ``order`` is sliced out (pow2 bucket), routed, stably
+  cumsum-rank-partitioned and written back — O(leaf) per split, exactly
+  the reference's per-leaf partition cost, summing to O(N·log L) per
+  tree instead of O(N·L);
 * per split only the **smaller child** is histogrammed — its rows are
   gathered through ``order`` into a power-of-two padded buffer chosen by
   ``lax.switch`` (static shapes, ~log2(N) compiled buckets) and reduced by
@@ -122,8 +125,7 @@ def decode_bundle_bin(raw, feat, meta: FeatureMeta):
 
 class _LoopState(NamedTuple):
     step: jnp.ndarray
-    row_leaf: jnp.ndarray        # [N] i32: leaf id per row
-    pos: jnp.ndarray             # [N] i32: position of each row in `order`
+    row_leaf: jnp.ndarray        # [N + 1] i32: leaf id per row (+ sentinel)
     order: jnp.ndarray           # [N + maxbuf] i32: row ids grouped by leaf
     leaf_start: jnp.ndarray      # [L] i32: first position of each leaf
     leaf_cnt: jnp.ndarray        # [L] i32: local row count of each leaf
@@ -320,15 +322,68 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
 
         branches = [bucket_branch(k) for k in range(kmin, kmax + 1)]
 
+        # ---- localized partition (DataPartition::Split,
+        # data_partition.hpp:94-146).  The reference re-partitions only the
+        # SPLITTING leaf's index range; the same here: each branch slices
+        # the leaf's window out of ``order``, routes just those rows, and
+        # writes the stably-partitioned window back — O(leaf) per split,
+        # not O(N).  Routing decisions follow tree.h:257-313.
+
+        def partition_branch(k):
+            size = 1 << k
+
+            def branch(args):
+                (order, row_leaf, start, cnt, new_leaf,
+                 feat, thr, dleft, is_cat_l, cat_row) = args
+                win = lax.dynamic_slice(order, (start,), (size,))
+                j = jnp.arange(size, dtype=jnp.int32)
+                valid = j < cnt
+                idx = jnp.where(valid, win, n)
+                col_idx = feat if meta.col is None else meta.col[feat]
+                # 2D gather (row, col) — per-dimension indices never
+                # overflow int32, unlike a flattened N*F index
+                binf = bins[jnp.minimum(idx, n - 1),
+                            col_idx].astype(jnp.int32)
+                if meta.col is not None:  # EFB: physical slot -> logical bin
+                    binf = decode_bundle_bin(binf, feat, meta)
+                mt_f = meta.missing_type[feat]
+                nb_f = meta.num_bin[feat]
+                db_f = meta.default_bin[feat]
+                is_missing = (((mt_f == MISSING_NAN) & (binf == nb_f - 1))
+                              | ((mt_f == MISSING_ZERO) & (binf == db_f)))
+                goes_left = jnp.where(is_missing, dleft, binf <= thr)
+                cat_go_left = cat_row[jnp.clip(binf, 0, cfg.max_bin - 1)]
+                goes_left = jnp.where(is_cat_l, cat_go_left, goes_left)
+                goes_left = goes_left & valid
+                m_right = valid & ~goes_left
+                c1 = jnp.cumsum(goes_left.astype(jnp.int32))
+                c0 = jnp.cumsum(m_right.astype(jnp.int32))
+                nl = c1[-1]
+                # stable two-way rank inside the window; rows past the
+                # leaf (and sentinel padding) keep their own slot so the
+                # write-back leaves neighbors untouched
+                rank = jnp.where(goes_left, c1 - 1, nl + c0 - 1)
+                rank = jnp.where(valid, rank, j)
+                new_win = jnp.zeros((size,), jnp.int32).at[rank].set(win)
+                order = lax.dynamic_update_slice(order, new_win, (start,))
+                # right-child rows change leaf id; sentinel writes land in
+                # the padded slot n
+                row_leaf = row_leaf.at[idx].set(
+                    jnp.where(m_right, new_leaf, row_leaf[idx]))
+                return order, row_leaf, nl
+            return branch
+
+        pbranches = [partition_branch(k) for k in range(kmin, kmax + 1)]
+
         # ---- root ----------------------------------------------------------
         root_g = strategy.reduce_scalar(jnp.sum(gw))
         root_h = strategy.reduce_scalar(jnp.sum(hw))
         root_c = strategy.reduce_scalar(jnp.sum(cw))
 
-        row_leaf = jnp.zeros((n,), jnp.int32)
-        pos0 = jnp.arange(n, dtype=jnp.int32)
+        row_leaf = jnp.zeros((n + 1,), jnp.int32)   # + sentinel slot n
         order0 = jnp.concatenate(
-            [pos0, jnp.full((maxbuf,), n, jnp.int32)])
+            [jnp.arange(n, dtype=jnp.int32),
+             jnp.full((maxbuf,), n, jnp.int32)])
         leaf_start0 = jnp.zeros((L,), jnp.int32)
         leaf_cnt0 = _set(jnp.zeros((L,), jnp.int32), 0, n)
 
@@ -384,42 +439,16 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             thr = splits.threshold[l]
             dleft = splits.default_left[l]
 
-            # --- decide row routing for leaf l (tree.h:257-313 semantics) ----
-            col_idx = feat if meta.col is None else meta.col[feat]
-            binf = lax.dynamic_index_in_dim(bins, col_idx, axis=1,
-                                            keepdims=False).astype(jnp.int32)
-            if meta.col is not None:  # EFB: physical slot -> logical bin
-                binf = decode_bundle_bin(binf, feat, meta)
-            mt_f = meta.missing_type[feat]
-            nb_f = meta.num_bin[feat]
-            db_f = meta.default_bin[feat]
-            is_missing = (((mt_f == MISSING_NAN) & (binf == nb_f - 1))
-                          | ((mt_f == MISSING_ZERO) & (binf == db_f)))
-            goes_left = jnp.where(is_missing, dleft, binf <= thr)
-            # categorical node: route by bin membership in the chosen set
-            # (CategoricalDecisionInner, tree.h:285-293)
-            cat_go_left = splits.cat_bins[l][
-                jnp.clip(binf, 0, cfg.max_bin - 1)]
-            goes_left = jnp.where(splits.is_cat[l], cat_go_left, goes_left)
-            in_leaf = state.row_leaf == l
-            row_leaf = jnp.where(in_leaf & ~goes_left, new_leaf, state.row_leaf)
-
-            # --- stable partition of the leaf's positions (DataPartition::
-            #     Split, data_partition.hpp:94-146): cumsum ranks + scatter ---
+            # --- localized routing + stable partition of leaf l's window
+            #     (only that leaf's slice of ``order`` is touched) ---------
             start = state.leaf_start[l]
             cnt = state.leaf_cnt[l]
-            m_left = in_leaf & goes_left
-            m_right = in_leaf & ~goes_left
-            c1 = jnp.cumsum(m_left.astype(jnp.int32))
-            c0 = jnp.cumsum(m_right.astype(jnp.int32))
-            nl = c1[-1]                       # local left count
+            kp = _bucket_index(cnt, kmin, kmax)
+            order, row_leaf, nl = lax.switch(
+                kp, pbranches,
+                (state.order, state.row_leaf, start, cnt, new_leaf,
+                 feat, thr, dleft, splits.is_cat[l], splits.cat_bins[l]))
             nr = cnt - nl
-            pos = jnp.where(
-                in_leaf,
-                start + jnp.where(m_left, c1 - 1, nl + c0 - 1),
-                state.pos)
-            order = jnp.full((n + maxbuf,), n, jnp.int32).at[pos].set(
-                jnp.arange(n, dtype=jnp.int32))
             leaf_start = _set(state.leaf_start, new_leaf, start + nl)
             leaf_cnt = _set(_set(state.leaf_cnt, l, nl), new_leaf, nr)
 
@@ -490,12 +519,12 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
 
             splits = _update_splits(splits, l, res_l)
             splits = _update_splits(splits, new_leaf, res_r)
-            return _LoopState(i + 1, row_leaf, pos, order, leaf_start,
+            return _LoopState(i + 1, row_leaf, order, leaf_start,
                               leaf_cnt, hist_store, splits, tree)
 
-        state = _LoopState(jnp.asarray(0, jnp.int32), row_leaf, pos0, order0,
+        state = _LoopState(jnp.asarray(0, jnp.int32), row_leaf, order0,
                            leaf_start0, leaf_cnt0, hist_store0, splits, tree)
         state = lax.while_loop(cond, body, state)
-        return state.tree, state.row_leaf
+        return state.tree, state.row_leaf[:n]
 
     return grow_tree

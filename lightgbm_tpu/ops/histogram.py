@@ -75,21 +75,39 @@ def subset_histogram_einsum(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
 def subset_histogram_segment(rows: jnp.ndarray, g: jnp.ndarray,
                              h: jnp.ndarray, c: jnp.ndarray,
-                             num_bins: int) -> jnp.ndarray:
-    """Histogram via one scatter-add (``segment_sum``) over the combined
+                             num_bins: int,
+                             rows_per_chunk: int = 16384) -> jnp.ndarray:
+    """Histogram via scatter-add (``segment_sum``) over the combined
     (feature, bin) index — O(M·F) adds instead of the einsum's O(M·F·B)
     MACs.  This IS the reference's dense_bin.hpp:66-132 accumulation in
     XLA form; scatter lowers well on CPU (where the fallback rungs run)
     but poorly on TPU, which is exactly why the TPU path is the MXU
-    one-hot contraction instead."""
+    one-hot contraction instead.  Chunked over rows (like the einsum
+    path) so the transient [chunk·F, 3] update buffer stays bounded."""
     rows = rows.astype(jnp.int32)
     m, f = rows.shape
     w = jnp.stack([g, h, c], axis=-1)                    # [M, 3]
-    idx = rows + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
-    vals = jnp.broadcast_to(w[:, None, :], (m, f, NUM_STATS))
-    hist = jax.ops.segment_sum(vals.reshape(-1, NUM_STATS),
-                               idx.reshape(-1),
-                               num_segments=f * num_bins)
+    chunk = min(rows_per_chunk, m)
+    pad = (-m) % chunk
+    if pad:
+        # padding rows: weight 0 into bin 0 — contributes nothing
+        rows = jnp.pad(rows, ((0, pad), (0, 0)))
+        w = jnp.pad(w, ((0, pad), (0, 0)))
+    n_chunks = (m + pad) // chunk
+    offsets = jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins
+    rows_c = rows.reshape(n_chunks, chunk, f)
+    w_c = w.reshape(n_chunks, chunk, NUM_STATS)
+
+    def body(acc, args):
+        rc, wc = args
+        idx = (rc + offsets).reshape(-1)
+        vals = jnp.broadcast_to(wc[:, None, :], (chunk, f, NUM_STATS))
+        part = jax.ops.segment_sum(vals.reshape(-1, NUM_STATS), idx,
+                                   num_segments=f * num_bins)
+        return acc + part, None
+
+    acc0 = jnp.zeros((f * num_bins, NUM_STATS), dtype=w.dtype)
+    hist, _ = lax.scan(body, acc0, (rows_c, w_c))
     return hist.reshape(f, num_bins, NUM_STATS)
 
 
