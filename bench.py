@@ -87,10 +87,14 @@ def child_main():
     sparsity = float(os.environ.get("BENCH_SPARSITY", 0))
     n_timed = int(os.environ.get("BENCH_TREES", 10))
     if platform_want == "cpu":
-        # the einsum fallback is ~1000x off TPU-class throughput; cap the
-        # shape so the last-resort rung finishes inside the stage timeout
-        # (vs_baseline stays honest — the baseline scales by rows)
-        n_rows = int(os.environ.get("BENCH_ROWS_CPU", min(n_rows, 100_000)))
+        # cap the last-resort rung so it finishes inside the stage timeout
+        # (vs_baseline stays honest — the baseline scales by rows).  With
+        # the segment-sum histogram + localized partition the CPU rung
+        # runs ~0.4 trees/s at 1M x 28; histogram work scales with
+        # rows x features, so the cap shrinks proportionally for wide
+        # shapes (never below 50k rows).
+        cap = max(50_000, int(1_000_000 * 28 / max(n_feat, 1)))
+        n_rows = int(os.environ.get("BENCH_ROWS_CPU", min(n_rows, cap)))
         n_timed = int(os.environ.get("BENCH_TREES_CPU", min(n_timed, 5)))
 
     import jax
