@@ -27,7 +27,7 @@ in a child subprocess (BENCH_CHILD=1) so a hung TPU tunnel or a Mosaic
 compile failure can never take down the bench.  A fallback ladder
   (1) tpu + pallas histogram kernel
   (2) tpu + einsum histograms        (Pallas compile failure)
-  (3) cpu + einsum                   (TPU unreachable / hung)
+  (3) cpu + segment_sum histograms   (TPU unreachable / hung)
 is walked until a child prints a result line; the final JSON always appears
 on stdout, with a "degraded" field naming any fallback taken (round-1
 failure was an unreachable TPU plugin; round-2 was a Mosaic compile error
