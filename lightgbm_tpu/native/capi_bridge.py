@@ -342,10 +342,11 @@ def booster_num_predict(bst, data_idx) -> int:
 
 
 def booster_get_predict(bst, data_idx):
-    """Objective-converted predictions of the train (0) / i-th valid (i)
-    set, row-major [n, num_class] — the reference LGBM_BoosterGetPredict
-    goes through GBDT::GetPredictAt which applies ConvertOutput
-    (sigmoid/softmax), not the raw margins."""
+    """Predictions of the train (0) / i-th valid (i) set, row-major
+    [n, num_class] — reference LGBM_BoosterGetPredict semantics
+    (GBDT::GetPredictAt, gbdt.cpp:756): ConvertOutput (sigmoid/softmax)
+    applies only when the model is NOT average_output; RF models return
+    the raw scores untouched."""
     if data_idx == 0:
         scores = np.asarray(bst.inner.scores, np.float64)
     else:
@@ -353,7 +354,9 @@ def booster_get_predict(bst, data_idx):
         if data_idx > len(sets):
             raise IndexError(f"data_idx {data_idx} out of range")
         scores = np.asarray(sets[data_idx - 1].scores, np.float64)
-    if bst.inner.objective is not None:
+    if bst.inner.objective is not None and not bst.inner.average_output:
+        # GBDT::GetPredictAt converts only when NOT average_output (RF
+        # returns the raw scores untouched, gbdt.cpp:756)
         scores = np.asarray(bst.inner.objective.convert_output(scores),
                             np.float64)
     out = np.ascontiguousarray(scores.T)         # [n, k]

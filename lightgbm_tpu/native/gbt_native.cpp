@@ -486,9 +486,13 @@ void GBTN_Predict(void* h, const double* X, long long n, int f,
     double* o = out + r * k;
     for (int c = 0; c < k; ++c) o[c] = 0.0;
     for (int t = 0; t < total; ++t) o[t % k] += m->trees[t].predict(fv);
-    if (m->average_output && iters > 0)
-      for (int c = 0; c < k; ++c) o[c] /= iters;
-    if (!raw_score) {
+    // GBDT::Predict semantics (gbdt_prediction.cpp:29-38): raw score is
+    // the plain SUM; average_output (RF) divides by the iteration count
+    // and applies NO objective transform; otherwise ConvertOutput.
+    if (!raw_score && m->average_output) {
+      if (iters > 0)
+        for (int c = 0; c < k; ++c) o[c] /= iters;
+    } else if (!raw_score) {
       if (m->objective.rfind("binary", 0) == 0) {
         o[0] = 1.0 / (1.0 + std::exp(-m->sigmoid * o[0]));
       } else if (m->objective.rfind("multiclassova", 0) == 0) {

@@ -202,8 +202,6 @@ class Predictor:
                 if (it + 1) % self.early_stop_freq == 0:
                     margin = self._margin(out[:, idx])
                     active[idx[margin >= self.early_stop_margin]] = False
-        if self.average_output and self.num_iteration > 0:
-            out /= self.num_iteration
         return out
 
     def _margin(self, scores: np.ndarray) -> np.ndarray:
@@ -215,8 +213,16 @@ class Predictor:
 
     def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
         out = self.predict_raw(X)
-        if not raw_score and self.objective is not None:
-            out = np.asarray(self.objective.convert_output(out), dtype=np.float64)
+        if not raw_score:
+            # GBDT::Predict (gbdt_prediction.cpp:29-38): average_output
+            # (RF) divides by the iteration count and does NOT apply the
+            # objective transform; otherwise ConvertOutput
+            if self.average_output:
+                if self.num_iteration > 0:
+                    out = out / self.num_iteration
+            elif self.objective is not None:
+                out = np.asarray(self.objective.convert_output(out),
+                                 dtype=np.float64)
         if out.shape[0] == 1:
             return out[0]
         return out.T  # [N, K] like the reference python package

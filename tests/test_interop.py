@@ -129,3 +129,28 @@ def test_training_quality_parity_bench_config(ref_bin, tmp_path):
 
     assert ours_auc > 0.85, ours_auc          # both actually learned
     assert abs(ours_auc - ref_auc) < 4e-4, (ours_auc, ref_auc)
+
+
+def test_dart_goss_rf_model_interop(ref_bin, tmp_path):
+    """DART / GOSS / RF model files are plain tree ensembles in the
+    reference text format — each must predict identically through the
+    reference CLI (gbdt.cpp:948+ serialization is boosting-type
+    agnostic; DART trees are saved already normalized)."""
+    train_path = "/root/reference/examples/binary_classification/binary.train"
+    if not os.path.exists(train_path):
+        pytest.skip("reference example data missing")
+    X, y, _ = load_text_file(train_path, label_idx=0)
+    for btype, extra in (("dart", {"drop_rate": 0.3}),
+                         ("goss", {}),
+                         ("rf", {"bagging_freq": 1,
+                                 "bagging_fraction": 0.7})):
+        params = {"objective": "binary", "num_leaves": 15,
+                  "boosting": btype, "verbose": -1, **extra}
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=8)
+        model_path = str(tmp_path / f"{btype}.txt")
+        bst.save_model(model_path)
+        ref = _ref_predict(ref_bin, model_path, train_path, tmp_path)
+        ours = np.asarray(bst.predict(X))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6,
+                                   err_msg=btype)

@@ -159,5 +159,8 @@ def test_pmml_multiclass_refused_rf_scaled():
                    lgb.Dataset(X, label=yb), num_boost_round=5)
     doc = model_to_pmml(rf.inner.save_model_to_string())
     got = _eval_pmml(doc, X[:200])
-    want = rf.inner.predictor().predict_raw(X[:200])[0]
+    # RF prediction = averaged raw sum with no objective transform
+    # (gbdt_prediction.cpp:29-38); PMML bakes the 1/iters scale into the
+    # leaf values, so it matches predict(), not the raw tree sum
+    want = np.asarray(rf.inner.predict(X[:200]))
     np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)
