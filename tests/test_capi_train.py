@@ -620,3 +620,29 @@ def test_capi_reset_training_data():
     lib.GBTN_BoosterFree(bst)
     for h in (ds, ds2, dv):
         lib.GBTN_DatasetFree(h)
+
+
+def test_capi_get_predict_rf_raw():
+    """GetPredict must NOT objective-convert average_output (RF) models —
+    reference GBDT::GetPredictAt returns their raw scores untouched."""
+    lib = get_lib()
+    X, y = _problem(500, 6, seed=6)
+    n, f = X.shape
+    params = ("objective=binary boosting=rf bagging_freq=1 "
+              "bagging_fraction=0.7 num_leaves=15 min_data_in_leaf=20 "
+              "verbose=-1")
+    ds = ctypes.c_void_p()
+    _ok(lib.GBTN_DatasetCreateFromMat(_dp(X), n, f, params.encode(),
+                                      _fp(y), None, ctypes.byref(ds)))
+    bst = _train_via_abi(ds, 6, params=params)
+    npred = ctypes.c_longlong(0)
+    _ok(lib.GBTN_BoosterGetNumPredict(bst, 0, ctypes.byref(npred)))
+    scores = np.zeros(npred.value, dtype=np.float64)
+    _ok(lib.GBTN_BoosterGetPredict(bst, 0, ctypes.byref(npred),
+                                   _dp(scores)))
+    # raw tree sums: spread far outside (0, 1); a sigmoid regression would
+    # squash them back inside
+    assert scores.min() < -0.5 or scores.max() > 1.5, \
+        (scores.min(), scores.max())
+    lib.GBTN_BoosterFree(bst)
+    lib.GBTN_DatasetFree(ds)

@@ -374,10 +374,14 @@ def test_reset_parameter_callback():
                   verbose=-1, learning_rate=lrs[0])
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
                     callbacks=[lgb.reset_parameter(learning_rate=lrs)])
-    # leaf values are stored unshrunk * lr at update time: ratios of the
-    # same tree trained under different lr show through prediction deltas;
-    # assert the live config followed the schedule instead
     assert bst.inner.config.learning_rate == lrs[-1]
+    # the schedule must actually shape the trees: a constant-lr run
+    # diverges from the scheduled one after iteration 0, while the first
+    # tree (same lr both times) is identical
+    const = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_allclose(bst.inner.models[0].leaf_value,
+                               const.inner.models[0].leaf_value)
+    assert not np.allclose(bst.predict(X[:100]), const.predict(X[:100]))
 
     # scheduled function form: lr(iter)
     bst2 = lgb.train(dict(params), lgb.Dataset(X, label=y),

@@ -154,3 +154,110 @@ def test_dart_goss_rf_model_interop(ref_bin, tmp_path):
         ours = np.asarray(bst.predict(X))
         np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6,
                                    err_msg=btype)
+
+
+def test_unbalance_scale_pos_weight_training_parity(ref_bin, tmp_path):
+    """is_unbalance / scale_pos_weight label-weighting must reproduce the
+    reference's training (binary_objective.hpp:55-86): same data, same
+    config on both sides — predictions agree to fp noise."""
+    train_path = "/root/reference/examples/binary_classification/binary.train"
+    if not os.path.exists(train_path):
+        pytest.skip("reference example data missing")
+    X, y, _ = load_text_file(train_path, label_idx=0)
+    for extra in ({"is_unbalance": "true"}, {"scale_pos_weight": "3.0"}):
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 20, "verbose": -1,
+                  **{k: (v == "true" if v in ("true", "false") else float(v))
+                     for k, v in extra.items()}}
+        ours = lgb.train(params, lgb.Dataset(X, label=y),
+                         num_boost_round=10)
+        model_path = tmp_path / "ub_model.txt"
+        conf = tmp_path / "ub.conf"
+        conf.write_text("\n".join(
+            [f"task=train", "objective=binary", f"data={train_path}",
+             "num_trees=10", "num_leaves=15", "min_data_in_leaf=20",
+             f"output_model={model_path}", "verbosity=-1"]
+            + [f"{k}={v}" for k, v in extra.items()]) + "\n")
+        subprocess.run([ref_bin, f"config={conf}"], check=True,
+                       capture_output=True, timeout=300)
+        ref = lgb.Booster(model_file=str(model_path))
+        np.testing.assert_allclose(np.asarray(ours.predict(X)),
+                                   np.asarray(ref.predict(X)),
+                                   rtol=1e-4, atol=1e-5, err_msg=str(extra))
+
+
+def test_multiclass_training_parity(ref_bin, tmp_path):
+    """Multiclass softmax training on the reference's own example data:
+    tree-for-tree agreement with the reference CLI (max pred diff ~1e-6)."""
+    train_path = ("/root/reference/examples/multiclass_classification/"
+                  "multiclass.train")
+    if not os.path.exists(train_path):
+        pytest.skip("reference example data missing")
+    X, y, _ = load_text_file(train_path, label_idx=0)
+    params = {"objective": "multiclass", "num_class": 5, "num_leaves": 15,
+              "min_data_in_leaf": 20, "verbose": -1}
+    ours = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    model_path = tmp_path / "mc_ref.txt"
+    conf = tmp_path / "mc.conf"
+    conf.write_text(
+        f"task=train\nobjective=multiclass\nnum_class=5\ndata={train_path}\n"
+        "num_trees=8\nnum_leaves=15\nmin_data_in_leaf=20\n"
+        f"output_model={model_path}\nverbosity=-1\n")
+    subprocess.run([ref_bin, f"config={conf}"], check=True,
+                   capture_output=True, timeout=300)
+    ref = lgb.Booster(model_file=str(model_path))
+    np.testing.assert_allclose(np.asarray(ours.predict(X)),
+                               np.asarray(ref.predict(X)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lambdarank_quality_parity(ref_bin, tmp_path):
+    """Lambdarank NDCG@5 on the reference's rank example must land within
+    the published CPU-vs-GPU envelope of the reference itself (~1e-2 —
+    tree-level equality is not expected: at iteration 0 all scores tie
+    and the reference's std::sort permutes the ranking arbitrarily)."""
+    train_path = "/root/reference/examples/lambdarank/rank.train"
+    test_path = "/root/reference/examples/lambdarank/rank.test"
+    if not os.path.exists(train_path):
+        pytest.skip("reference example data missing")
+    from lightgbm_tpu.data.metadata import Metadata
+    Xt, yt, _ = load_text_file(test_path, label_idx=0)
+    meta = Metadata(len(yt))
+    meta.load_side_files(test_path)
+    qb = np.asarray(meta.query_boundaries)
+
+    def ndcg_at(scores, k=5):
+        tot, cnt = 0.0, 0
+        for q in range(len(qb) - 1):
+            s, e = qb[q], qb[q + 1]
+            y, p = yt[s:e], scores[s:e]
+            if y.max() <= 0:
+                continue
+            top = np.argsort(-p)[:k]
+            dcg = ((2 ** y[top] - 1)
+                   / np.log2(np.arange(len(top)) + 2)).sum()
+            ideal = np.sort(y)[::-1][:k]
+            idcg = ((2 ** ideal - 1)
+                    / np.log2(np.arange(len(ideal)) + 2)).sum()
+            tot += dcg / idcg
+            cnt += 1
+        return tot / cnt
+
+    params = {"objective": "lambdarank", "num_leaves": 31, "verbose": -1,
+              "metric": "ndcg", "learning_rate": 0.1, "min_data_in_leaf": 1}
+    ours = lgb.train(params, lgb.Dataset(train_path), num_boost_round=50)
+    ours_ndcg = ndcg_at(np.asarray(ours.predict(Xt)))
+
+    model_path = tmp_path / "lr_ref.txt"
+    conf = tmp_path / "lr.conf"
+    conf.write_text(
+        f"task=train\nobjective=lambdarank\ndata={train_path}\n"
+        "num_trees=50\nnum_leaves=31\nlearning_rate=0.1\n"
+        f"min_data_in_leaf=1\noutput_model={model_path}\nverbosity=-1\n")
+    subprocess.run([ref_bin, f"config={conf}"], check=True,
+                   capture_output=True, timeout=600)
+    ref = lgb.Booster(model_file=str(model_path))
+    ref_ndcg = ndcg_at(np.asarray(ref.predict(Xt)))
+
+    assert ours_ndcg > 0.60, ours_ndcg
+    assert ours_ndcg > ref_ndcg - 0.01, (ours_ndcg, ref_ndcg)
