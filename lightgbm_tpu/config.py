@@ -326,6 +326,13 @@ def check_param_conflicts(cfg: Config) -> None:
             log.fatal("Random forest needs bagging (bagging_freq > 0 and 0 < bagging_fraction < 1)")
     if cfg.max_bin > 65535:
         log.fatal("max_bin too large (must fit uint16)")
+    # parallel <-> learner coupling (config.cpp:212-225): a serial learner
+    # forces single-machine; multiple machines with serial would otherwise
+    # hang waiting for a network that no strategy uses
+    if cfg.tree_learner == "serial" and cfg.num_machines > 1:
+        log.warning("tree_learner=serial forces num_machines=1 "
+                    "(config.cpp:222-225 semantics)")
+        cfg.num_machines = 1
     # Pallas grid knobs: catch bad values here with the real cause instead
     # of an opaque Mosaic layout error at trace/compile time
     if cfg.pallas_row_tile <= 0 or cfg.pallas_row_tile % 128 != 0:

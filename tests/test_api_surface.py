@@ -55,3 +55,12 @@ def test_subset_grouped_and_free_dataset():
     assert bst.inner.bins is None and bst.inner.train_set is None
     np.testing.assert_array_equal(bst.predict(X[:50]), p1)
     assert "Tree=" in bst.model_to_string()
+
+
+def test_serial_learner_forces_single_machine():
+    """config.cpp:212-225: tree_learner=serial + num_machines>1 resolves
+    to single-machine instead of hanging on an unused network."""
+    from lightgbm_tpu.config import config_from_params
+    cfg = config_from_params(dict(tree_learner="serial", num_machines=4,
+                                  verbose=-1))
+    assert cfg.num_machines == 1
