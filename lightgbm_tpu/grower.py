@@ -130,6 +130,7 @@ class _LoopState(NamedTuple):
     leaf_start: jnp.ndarray      # [L] i32: first position of each leaf
     leaf_cnt: jnp.ndarray        # [L] i32: local row count of each leaf
     hist_store: jnp.ndarray      # [L, F, B, 3]: per-leaf histograms
+    feat_ok: jnp.ndarray         # [L, E] bool: per-leaf is_splittable flags
     splits: SplitResult          # per-leaf SoA, each field [L]
     tree: TreeArrays
 
@@ -148,8 +149,13 @@ class SerialStrategy:
     * ``reduce_hist(hist) -> hist`` — cross-shard reduction of a freshly
       measured histogram (data-parallel: ``psum``; voting: identity, its
       reduction happens selectively inside ``find``);
-    * ``find(ctx, hist, pg, ph, pc) -> SplitResult`` — globally agreed best
-      split (feature indices in the full/global numbering);
+    * ``find(ctx, hist, pg, ph, pc, feat_ok) -> (SplitResult, feat_ok')``
+      — globally agreed best split (feature indices in the full/global
+      numbering) plus the leaf's per-feature is_splittable flags.
+      ``feat_ok`` [E] carries the PARENT leaf's flags: features it
+      prunes are excluded from this scan, and from the whole subtree —
+      the reference's feature-pruning heuristic
+      (serial_tree_learner.cpp:406-417);
     * ``reduce_scalar(x)`` — global sums of row statistics.
     """
 
@@ -167,13 +173,14 @@ class SerialStrategy:
     def reduce_hist(self, hist):
         return hist
 
-    def find(self, ctx, hist, pg, ph, pc):
+    def find(self, ctx, hist, pg, ph, pc, feat_ok):
         meta, feat_valid, maps = ctx
         if maps is not None:
             hist = expand_bundle_hist(hist, pg, ph, pc, maps)
         return best_split(hist, pg, ph, pc, meta.num_bin,
-                          meta.missing_type, meta.default_bin, feat_valid,
-                          self.cfg.split_config(), is_cat=meta.is_categorical)
+                          meta.missing_type, meta.default_bin,
+                          feat_valid & feat_ok, self.cfg.split_config(),
+                          is_cat=meta.is_categorical, with_feat_ok=True)
 
     def reduce_scalar(self, x):
         return x
@@ -300,8 +307,8 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
         hw_pad = jnp.concatenate([hw, jnp.zeros((1,), dtype)])
         cw_pad = jnp.concatenate([cw, jnp.zeros((1,), dtype)])
 
-        def find(hist, pg, ph, pc):
-            return strategy.find(ctx, hist, pg, ph, pc)
+        def find(hist, pg, ph, pc, feat_ok):
+            return strategy.find(ctx, hist, pg, ph, pc, feat_ok)
 
         def measure(idx):
             """Histogram of rows ``idx`` (sentinel-padded) -> [F_hist, B, 3]."""
@@ -387,16 +394,21 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
         leaf_start0 = jnp.zeros((L,), jnp.int32)
         leaf_cnt0 = _set(jnp.zeros((L,), jnp.int32), 0, n)
 
+        num_logical = meta.num_bin.shape[0]
+        feat_ok_all = jnp.ones((num_logical,), bool)
         hist_root = strategy.reduce_hist(
             subset_histogram(hbins, gw, hw, cw, cfg.max_bin,
                              method=cfg.hist_method,
                              feat_tile=cfg.feat_tile,
                              row_tile=cfg.row_tile))
-        res_root = find(hist_root, root_g, root_h, root_c)
+        res_root, root_feat_ok = find(hist_root, root_g, root_h, root_c,
+                                      feat_ok_all)
         res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
 
         hist_store0 = jnp.zeros((L, fh, cfg.max_bin, 3), dtype)
         hist_store0 = hist_store0.at[0].set(hist_root)
+        feat_ok_store0 = jnp.zeros((L, num_logical), bool).at[0].set(
+            root_feat_ok)
 
         def blank_res(x):
             return jnp.zeros((L,) + x.shape, x.dtype)
@@ -510,20 +522,31 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             hist_store = lax.dynamic_update_index_in_dim(
                 hist_store, hist_r, new_leaf, axis=0)
 
-            res_l = find(hist_l, splits.left_sum_g[l], splits.left_sum_h[l],
-                         splits.left_count[l])
-            res_r = find(hist_r, splits.right_sum_g[l], splits.right_sum_h[l],
-                         splits.right_count[l])
+            # children scan only the features the PARENT found splittable
+            # (serial_tree_learner.cpp:406-417 pruning heuristic)
+            fok_parent = lax.dynamic_index_in_dim(state.feat_ok, l, axis=0,
+                                                  keepdims=False)
+            res_l, fok_l = find(hist_l, splits.left_sum_g[l],
+                                splits.left_sum_h[l], splits.left_count[l],
+                                fok_parent)
+            res_r, fok_r = find(hist_r, splits.right_sum_g[l],
+                                splits.right_sum_h[l],
+                                splits.right_count[l], fok_parent)
             res_l = _depth_gate(res_l, child_depth, cfg.max_depth)
             res_r = _depth_gate(res_r, child_depth, cfg.max_depth)
+            feat_ok = lax.dynamic_update_index_in_dim(
+                state.feat_ok, fok_l & fok_parent, l, axis=0)
+            feat_ok = lax.dynamic_update_index_in_dim(
+                feat_ok, fok_r & fok_parent, new_leaf, axis=0)
 
             splits = _update_splits(splits, l, res_l)
             splits = _update_splits(splits, new_leaf, res_r)
             return _LoopState(i + 1, row_leaf, order, leaf_start,
-                              leaf_cnt, hist_store, splits, tree)
+                              leaf_cnt, hist_store, feat_ok, splits, tree)
 
         state = _LoopState(jnp.asarray(0, jnp.int32), row_leaf, order0,
-                           leaf_start0, leaf_cnt0, hist_store0, splits, tree)
+                           leaf_start0, leaf_cnt0, hist_store0,
+                           feat_ok_store0, splits, tree)
         state = lax.while_loop(cond, body, state)
         return state.tree, state.row_leaf[:n]
 

@@ -414,7 +414,7 @@ def best_split(hist: jnp.ndarray,
                num_bin: jnp.ndarray, missing_type: jnp.ndarray,
                default_bin: jnp.ndarray, feat_valid: jnp.ndarray,
                cfg: SplitConfig, feature_base: int = 0,
-               is_cat: jnp.ndarray = None) -> SplitResult:
+               is_cat: jnp.ndarray = None, with_feat_ok: bool = False):
     """Best split (numerical or categorical) across all features of one leaf.
 
     hist: [F, B, 3] (sum_g, sum_h, count); num_bin/missing_type/default_bin:
@@ -422,6 +422,13 @@ def best_split(hist: jnp.ndarray,
     [F] bool (None ⇒ all numerical).  parent_*: scalars for the leaf.
     ``feature_base`` offsets the reported feature index (feature-parallel
     shards).
+
+    ``with_feat_ok=True`` additionally returns the per-feature
+    ``is_splittable`` flags [F] — True when the feature produced ANY
+    candidate beating min_gain_shift on this leaf.  The reference prunes
+    features whose parent leaf had no such candidate from the entire
+    subtree (serial_tree_learner.cpp:406-417), so the grower records
+    these flags per leaf and gates children's scans with them.
     """
     f, b, _ = hist.shape
     use_cat = cfg.has_categorical and is_cat is not None
@@ -437,6 +444,8 @@ def best_split(hist: jnp.ndarray,
                                  min_gain_shift, tot_h, l1, l2, f, b,
                                  feature_base)
     if not use_cat:
+        if with_feat_ok:
+            return num_res, jnp.max(gains, axis=1) > -jnp.inf
         return num_res
 
     (cgains, clg, clh, clc, cpos, cp1, order, used_bin,
@@ -455,8 +464,13 @@ def best_split(hist: jnp.ndarray,
                                 | (cat_res.gain > num_res.gain)
                                 | ((cat_res.gain == num_res.gain)
                                    & (cat_res.feature < num_res.feature)))
-    return jax.tree.map(lambda a, c: jnp.where(pick_cat, c, a),
-                        num_res, cat_res)
+    res = jax.tree.map(lambda a, c: jnp.where(pick_cat, c, a),
+                       num_res, cat_res)
+    if with_feat_ok:
+        ok = jnp.where(is_cat, jnp.max(cgains, axis=1) > -jnp.inf,
+                       jnp.max(gains, axis=1) > -jnp.inf)
+        return res, ok
+    return res
 
 
 def per_feature_best_gain(hist: jnp.ndarray,

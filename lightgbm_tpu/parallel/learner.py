@@ -127,25 +127,38 @@ class FeatureParallelStrategy(SerialStrategy):
     def hist_bins(self, ctx, bins):
         return ctx[2]
 
-    def find(self, ctx, hist_child, pg, ph, pc):
+    def find(self, ctx, hist_child, pg, ph, pc, feat_ok):
         meta, feat_valid, _, meta_local, fv_local, start, maps = ctx
         if maps is not None:
             # expand the local physical histograms into the (global) logical
             # feature space; features outside this shard's window are zeroed
             # and masked, so the global numbering needs no feature_base shift
             hist_log = expand_bundle_hist(hist_child, pg, ph, pc, maps)
-            res = best_split(hist_log, pg, ph, pc, meta.num_bin,
-                             meta.missing_type, meta.default_bin,
-                             feat_valid & maps[5], self.cfg.split_config(),
-                             is_cat=meta.is_categorical)
+            res, ok = best_split(hist_log, pg, ph, pc, meta.num_bin,
+                                 meta.missing_type, meta.default_bin,
+                                 feat_valid & maps[5] & feat_ok,
+                                 self.cfg.split_config(),
+                                 is_cat=meta.is_categorical,
+                                 with_feat_ok=True)
+            ok_global = ok & maps[5]
         else:
+            fok_local = lax.dynamic_slice(feat_ok, (start,),
+                                          (fv_local.shape[0],))
             # feature_base shifts to global numbering before the argmax sync
-            res = best_split(hist_child, pg, ph, pc, meta_local.num_bin,
-                             meta_local.missing_type, meta_local.default_bin,
-                             fv_local, self.cfg.split_config(),
-                             feature_base=start,
-                             is_cat=meta_local.is_categorical)
-        return _broadcast_from_winner(res, self.axis)
+            res, ok = best_split(hist_child, pg, ph, pc, meta_local.num_bin,
+                                 meta_local.missing_type,
+                                 meta_local.default_bin,
+                                 fv_local & fok_local,
+                                 self.cfg.split_config(),
+                                 feature_base=start,
+                                 is_cat=meta_local.is_categorical,
+                                 with_feat_ok=True)
+            ok_global = lax.dynamic_update_slice(
+                jnp.zeros_like(feat_ok), ok, (start,))
+        # every shard owns a disjoint feature window: OR across shards
+        # rebuilds the full is_splittable vector identically everywhere
+        ok_global = lax.psum(ok_global.astype(jnp.int32), self.axis) > 0
+        return _broadcast_from_winner(res, self.axis), ok_global
 
 
 class VotingStrategy(SerialStrategy):
@@ -170,8 +183,9 @@ class VotingStrategy(SerialStrategy):
     # communication compression); the parent-minus-smaller subtraction in
     # the grower is therefore performed in each shard's local space.
 
-    def find(self, ctx, hist_child, pg, ph, pc):
+    def find(self, ctx, hist_child, pg, ph, pc, feat_ok):
         meta, feat_valid, maps = ctx
+        feat_valid = feat_valid & feat_ok
         scfg = self.cfg.split_config()
         if maps is not None:
             # EFB: expand the LOCAL physical histograms with LOCAL parent
@@ -208,13 +222,23 @@ class VotingStrategy(SerialStrategy):
         sel = votes[top_idx, 1].astype(jnp.int32)        # [2k]
         # reduce only the selected features' histograms (CopyLocalHistogram)
         hist_sel = lax.psum(hist_child[sel], self.axis)  # [2k, B, 3]
-        res = best_split(hist_sel, pg, ph, pc, meta.num_bin[sel],
-                         meta.missing_type[sel], meta.default_bin[sel],
-                         feat_valid[sel], scfg,
-                         is_cat=meta.is_categorical[sel])
+        res, sel_ok = best_split(hist_sel, pg, ph, pc, meta.num_bin[sel],
+                                 meta.missing_type[sel],
+                                 meta.default_bin[sel],
+                                 feat_valid[sel], scfg,
+                                 is_cat=meta.is_categorical[sel],
+                                 with_feat_ok=True)
         res = res._replace(feature=jnp.where(res.found, sel[jnp.clip(
             res.feature, 0, sel.shape[0] - 1)], -1))
-        return res
+        # is_splittable only from the GLOBALLY-reduced scan of the voted
+        # features; features this round never examined globally stay
+        # splittable.  (Local gains use per-shard counts, so deriving the
+        # flag from them would freeze subtrees whose per-shard row counts
+        # fall under min_data_in_leaf even though the leaf is globally
+        # splittable.)  sel is identical on every shard, so the state
+        # stays shard-consistent without a collective.
+        ok = jnp.ones_like(feat_ok).at[sel].set(sel_ok)
+        return res, ok
 
 
 def make_distributed_grower(cfg: GrowerConfig, mesh: Mesh,
