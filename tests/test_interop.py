@@ -429,3 +429,53 @@ def test_missing_modes_training_parity(ref_bin, tmp_path):
                                    np.asarray(ref.predict(Xr)),
                                    rtol=1e-4, atol=1e-4,
                                    err_msg=str(extra))
+
+
+def test_continued_training_and_ova_parity(ref_bin, tmp_path):
+    """(a) Continued training ACROSS implementations: stage 1 trained by
+    the reference CLI, stage 2 trained by us via init_model, must equal
+    the reference training both stages (~8e-8).  (b) multiclassova
+    trains tree-for-tree (~1e-6)."""
+    btrain = "/root/reference/examples/binary_classification/binary.train"
+    mtrain = ("/root/reference/examples/multiclass_classification/"
+              "multiclass.train")
+    if not (os.path.exists(btrain) and os.path.exists(mtrain)):
+        pytest.skip("reference example data missing")
+    X, _, _ = load_text_file(btrain, label_idx=0)
+    c1 = tmp_path / "c1_ref.txt"
+    c2 = tmp_path / "c2_ref.txt"
+    (tmp_path / "c1.conf").write_text(
+        f"task=train\nobjective=binary\ndata={btrain}\nnum_trees=5\n"
+        f"num_leaves=15\noutput_model={c1}\nverbosity=-1\n")
+    subprocess.run([ref_bin, f"config={tmp_path / 'c1.conf'}"], check=True,
+                   capture_output=True, timeout=300)
+    (tmp_path / "c2.conf").write_text(
+        f"task=train\nobjective=binary\ndata={btrain}\nnum_trees=5\n"
+        f"num_leaves=15\ninput_model={c1}\noutput_model={c2}\n"
+        "verbosity=-1\n")
+    subprocess.run([ref_bin, f"config={tmp_path / 'c2.conf'}"], check=True,
+                   capture_output=True, timeout=300)
+    ours = lgb.train({"objective": "binary", "num_leaves": 15,
+                      "verbose": -1},
+                     lgb.Dataset(btrain, free_raw_data=False),
+                     num_boost_round=5, init_model=str(c1))
+    ref2 = lgb.Booster(model_file=str(c2))
+    np.testing.assert_allclose(np.asarray(ours.predict(X)),
+                               np.asarray(ref2.predict(X)),
+                               rtol=1e-4, atol=1e-5)
+
+    Xm, ym, _ = load_text_file(mtrain, label_idx=0)
+    params = {"objective": "multiclassova", "num_class": 5,
+              "num_leaves": 15, "min_data_in_leaf": 20, "verbose": -1}
+    ours = lgb.train(params, lgb.Dataset(Xm, label=ym), num_boost_round=5)
+    mo = tmp_path / "mo_ref.txt"
+    (tmp_path / "mo.conf").write_text(
+        f"task=train\nobjective=multiclassova\nnum_class=5\ndata={mtrain}\n"
+        "num_trees=5\nnum_leaves=15\nmin_data_in_leaf=20\n"
+        f"output_model={mo}\nverbosity=-1\n")
+    subprocess.run([ref_bin, f"config={tmp_path / 'mo.conf'}"], check=True,
+                   capture_output=True, timeout=300)
+    ref = lgb.Booster(model_file=str(mo))
+    np.testing.assert_allclose(np.asarray(ours.predict(Xm)),
+                               np.asarray(ref.predict(Xm)),
+                               rtol=1e-4, atol=1e-5)
