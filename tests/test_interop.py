@@ -479,3 +479,40 @@ def test_continued_training_and_ova_parity(ref_bin, tmp_path):
     np.testing.assert_allclose(np.asarray(ours.predict(Xm)),
                                np.asarray(ref.predict(Xm)),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_metric_values_match_reference_log(ref_bin, tmp_path):
+    """Training-log metric VALUES match the reference CLI digit-for-digit
+    (weighted binary_logloss and weighted AUC on both the training and
+    validation sets — binary.train carries a .weight side file)."""
+    tp = "/root/reference/examples/binary_classification/binary.train"
+    vp = "/root/reference/examples/binary_classification/binary.test"
+    if not os.path.exists(tp):
+        pytest.skip("reference example data missing")
+    conf = tmp_path / "m.conf"
+    conf.write_text(
+        f"task=train\nobjective=binary\ndata={tp}\nvalid_data={vp}\n"
+        "num_trees=5\nnum_leaves=15\nmetric=binary_logloss,auc\n"
+        "is_training_metric=true\nmetric_freq=1\n"
+        f"output_model={tmp_path / 'm_ref.txt'}\n")
+    r = subprocess.run([ref_bin, f"config={conf}"], check=True,
+                       capture_output=True, text=True, timeout=300)
+    ref_vals = {}
+    for line in r.stdout.splitlines():
+        mobj = __import__("re").match(
+            r".*Iteration:5, (\S+) (\S+) : ([\d.]+)", line)
+        if mobj:
+            ref_vals[(mobj.group(1), mobj.group(2))] = float(mobj.group(3))
+    assert len(ref_vals) == 4, r.stdout
+
+    evals = {}
+    d = lgb.Dataset(tp)
+    lgb.train({"objective": "binary", "num_leaves": 15,
+               "metric": ["binary_logloss", "auc"], "verbose": -1},
+              d, num_boost_round=5,
+              valid_sets=[d, d.create_valid(vp)],
+              valid_names=["training", "valid_1"],
+              callbacks=[lgb.record_evaluation(evals)])
+    for (name, metric), rv in ref_vals.items():
+        ours = evals[name][metric][-1]
+        assert abs(ours - rv) < 1e-5, (name, metric, ours, rv)
