@@ -254,7 +254,11 @@ def _parse_value(name: str, value: Any) -> Any:
         else:
             parts = [value]
         if name == "ndcg_eval_at":
-            return [int(p) for p in parts]
+            ks = sorted(int(p) for p in parts)   # ascending, like the
+            for k in ks:                         # reference (config.cpp:341)
+                if k <= 0:
+                    log.fatal("eval_at positions must be positive; got %d", k)
+            return ks
         if name == "label_gain":
             return [float(p) for p in parts]
         return [str(p) for p in parts]

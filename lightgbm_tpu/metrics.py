@@ -300,19 +300,24 @@ class MapMetric(_RankMetric):
         nq = len(bounds) - 1
         results = np.zeros(len(self.eval_at), dtype=np.float64)
         for q in range(nq):
-            ls = (self.label[bounds[q]:bounds[q + 1]] > 0).astype(np.float64)
+            # binary relevance at label > 0.5 (map_metric.hpp:63)
+            ls = (self.label[bounds[q]:bounds[q + 1]] > 0.5).astype(np.float64)
             ss = s[bounds[q]:bounds[q + 1]]
+            npos = int(ls.sum())          # positives in the WHOLE query
             order = np.argsort(-ss, kind="mergesort")
             rel = ls[order]
             hits = np.cumsum(rel)
             prec = hits / (np.arange(len(rel)) + 1.0)
             for ki, k in enumerate(self.eval_at):
                 kk = min(k, len(rel))
-                nrel = rel[:kk].sum()
-                if nrel > 0:
-                    results[ki] += qw[q] * float((prec[:kk] * rel[:kk]).sum() / nrel)
+                if npos > 0:
+                    # CalMapAtK: sum of precisions at hit positions within
+                    # top-k, normalized by min(total positives, k) — NOT by
+                    # the hits inside the window
+                    ap = float((prec[:kk] * rel[:kk]).sum())
+                    results[ki] += qw[q] * ap / min(npos, kk)
                 else:
-                    results[ki] += qw[q]
+                    results[ki] += qw[q]   # no-positive query counts as 1
         return list(results / qw.sum())
 
 

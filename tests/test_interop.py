@@ -518,32 +518,31 @@ def test_metric_values_match_reference_log(ref_bin, tmp_path):
         assert abs(ours - rv) < 1e-5, (name, metric, ours, rv)
 
 
-def test_ndcg_metric_values_match_reference(ref_bin, tmp_path):
-    """NDCG@{1,3,5} computed by our metric on the reference model's OWN
-    scores matches the reference CLI's printed eval digit-for-digit
-    (tie-free full model; on coarse models with tied scores the
-    reference's unstable std::sort breaks ties arbitrarily,
-    dcg_calculator.cpp:93-95, where ours is stable)."""
-    tp = "/root/reference/examples/lambdarank/rank.train"
-    vp = "/root/reference/examples/lambdarank/rank.test"
-    if not os.path.exists(tp):
-        pytest.skip("reference example data missing")
+def _rank_metric_vs_reference(ref_bin, tmp_path, metric, conf_key):
+    """Train a 50-tree lambdarank model with the reference CLI, then
+    compare OUR metric computed on that model's own scores against the
+    reference's printed iteration-50 eval, digit for digit."""
     import re
     from lightgbm_tpu.data.metadata import Metadata
     from lightgbm_tpu.metrics import create_metric
     from lightgbm_tpu.config import config_from_params
 
-    conf = tmp_path / "mr50.conf"
-    model_path = tmp_path / "mr50_ref.txt"
+    tp = "/root/reference/examples/lambdarank/rank.train"
+    vp = "/root/reference/examples/lambdarank/rank.test"
+    if not os.path.exists(tp):
+        pytest.skip("reference example data missing")
+    conf = tmp_path / f"{metric}.conf"
+    model_path = tmp_path / f"{metric}_ref.txt"
     conf.write_text(
         f"task=train\nobjective=lambdarank\ndata={tp}\nvalid_data={vp}\n"
-        "num_trees=50\nnum_leaves=31\nmetric=ndcg\nndcg_at=1,3,5\n"
+        f"num_trees=50\nnum_leaves=31\nmetric={metric}\n{conf_key}=1,3,5\n"
         f"metric_freq=50\noutput_model={model_path}\n")
     r = subprocess.run([ref_bin, f"config={conf}"], check=True,
                        capture_output=True, text=True, timeout=600)
     ref_vals = {}
     for line in r.stdout.splitlines():
-        mo = re.match(r".*Iteration:50, valid_1 (ndcg@\d) : ([\d.]+)", line)
+        mo = re.match(rf".*Iteration:50, valid_1 ({metric}@\d) : ([\d.]+)",
+                      line)
         if mo:
             ref_vals[mo.group(1)] = float(mo.group(2))
     assert len(ref_vals) == 3, r.stdout
@@ -554,11 +553,28 @@ def test_ndcg_metric_values_match_reference(ref_bin, tmp_path):
     meta.set_label(np.asarray(yv, np.float32))
     ref = lgb.Booster(model_file=str(model_path))
     scores = np.asarray(ref.predict(Xv, raw_score=True))[None, :]
-    assert len(np.unique(scores)) == scores.size   # tie-free premise
-    cfg = config_from_params({"metric": "ndcg", "ndcg_eval_at": [1, 3, 5],
+    cfg = config_from_params({"metric": metric, "ndcg_eval_at": [1, 3, 5],
                               "verbose": -1})
-    m = create_metric("ndcg", cfg)
+    m = create_metric(metric, cfg)
     m.init(meta, len(yv))
     ours = dict(zip(m.names(), [float(v) for v in m.eval(scores, None)]))
     for k, rv in ref_vals.items():
         assert abs(ours[k] - rv) < 1e-5, (k, ours[k], rv)
+    return scores
+
+
+def test_ndcg_metric_values_match_reference(ref_bin, tmp_path):
+    """NDCG on the reference model's OWN scores matches its printed eval
+    digit-for-digit (tie-free full model; on coarse models with tied
+    scores the reference's unstable std::sort breaks ties arbitrarily,
+    dcg_calculator.cpp:93-95, where ours is stable)."""
+    scores = _rank_metric_vs_reference(ref_bin, tmp_path, "ndcg", "ndcg_at")
+    assert len(np.unique(scores)) == scores.size   # tie-free premise
+
+
+def test_map_metric_values_match_reference(ref_bin, tmp_path):
+    """MAP on the reference model's own scores matches its printed eval
+    exactly — including normalization by min(whole-query positives, k)
+    and the 1.0 credit only for queries with NO positives
+    (map_metric.hpp CalMapAtK)."""
+    _rank_metric_vs_reference(ref_bin, tmp_path, "map", "eval_at")
