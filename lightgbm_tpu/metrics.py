@@ -213,17 +213,36 @@ class XentropyMetric(Metric):
 
 class XentLambdaMetric(Metric):
     """xentropy_metric.hpp — cross entropy with 'lambda' parameterization."""
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.weight is not None and np.asarray(self.weight).min() <= 0:
+            log.fatal("[xentlambda]: (metric) all weights must be positive")
     name = "xentlambda"
 
     def eval(self, score, objective):
-        # hhat = log1p(exp(score)); loss = yl*log(..)… follows the reference:
-        # loss = -y*log(1-exp(-hhat)) + (1-y)*hhat with hhat = log1p(exp(s))
+        # XentLambdaLoss (xentropy_metric.hpp:50-52): weights scale hhat
+        # INSIDE the probability transform — prob = 1 - exp(-w * hhat)
+        # with hhat = log1p(exp(s)) — and the final average is a PLAIN
+        # mean over rows ("weights have a different meaning than for
+        # xentropy", :160); log args clipped at 1e-12 like XentLoss
         s = np.asarray(score[0], dtype=np.float64)
-        hhat = np.log1p(np.exp(s))
-        z = np.clip(1.0 - np.exp(-hhat), K_EPSILON, 1 - K_EPSILON)
+        # during training hhat comes from the OBJECTIVE's ConvertOutput
+        # (xentropy_metric.hpp:206-219 — even when the objective is not
+        # xentlambda, the reference feeds its transform straight in);
+        # standalone eval auto-converts via log1p(exp(s))
+        if objective is not None:
+            hhat = np.asarray(objective.convert_output(s), np.float64)
+        else:
+            hhat = np.log1p(np.exp(s))
+        w = (np.asarray(self.weight, np.float64)
+             if self.weight is not None else 1.0)
+        z = 1.0 - np.exp(-w * hhat)
         y = self.label
-        loss = -(y * np.log(z) + (1 - y) * np.log(1 - z))
-        return [self._avg(loss)]
+        eps = 1.0e-12
+        loss = -(y * np.log(np.maximum(z, eps))
+                 + (1 - y) * np.log(np.maximum(1.0 - z, eps)))
+        return [float(np.mean(loss))]
 
 
 class KLDivMetric(Metric):
@@ -234,8 +253,10 @@ class KLDivMetric(Metric):
         p = np.clip(1.0 / (1.0 + np.exp(-np.asarray(score[0], np.float64))),
                     K_EPSILON, 1 - K_EPSILON)
         y = np.clip(self.label, 0.0, 1.0)
+        # YentLoss: x*log(x) = 0 at x in {0, 1} — mask before log
+        ys = np.clip(y, K_EPSILON, 1 - K_EPSILON)
         ent = np.where((y > 0) & (y < 1),
-                       y * np.log(y) + (1 - y) * np.log(1 - y), 0.0)
+                       y * np.log(ys) + (1 - y) * np.log(1 - ys), 0.0)
         loss = ent - (y * np.log(p) + (1 - y) * np.log(1 - p))
         return [self._avg(loss)]
 

@@ -578,3 +578,35 @@ def test_map_metric_values_match_reference(ref_bin, tmp_path):
     and the 1.0 credit only for queries with NO positives
     (map_metric.hpp CalMapAtK)."""
     _rank_metric_vs_reference(ref_bin, tmp_path, "map", "eval_at")
+
+
+def test_xentlambda_metric_value_parity(ref_bin, tmp_path):
+    """xentlambda metric matches the reference in BOTH wirings: with the
+    matching xentlambda objective, and the mismatched-objective path
+    where the reference feeds the objective's ConvertOutput straight in
+    as hhat (xentropy_metric.hpp:206-219)."""
+    tp = "/root/reference/examples/binary_classification/binary.train"
+    if not os.path.exists(tp):
+        pytest.skip("reference example data missing")
+    import re
+    for obj in ("xentlambda", "xentropy"):
+        conf = tmp_path / "xl.conf"
+        conf.write_text(
+            f"task=train\nobjective={obj}\ndata={tp}\nnum_trees=5\n"
+            "num_leaves=15\nmetric=xentlambda\nis_training_metric=true\n"
+            f"metric_freq=5\noutput_model={tmp_path / 'xl_ref.txt'}\n")
+        r = subprocess.run([ref_bin, f"config={conf}"], check=True,
+                           capture_output=True, text=True, timeout=300)
+        mo = [re.match(r".*Iteration:5, training xentlambda : ([\d.]+)", l)
+              for l in r.stdout.splitlines()]
+        ref_val = next(float(m.group(1)) for m in mo if m)
+
+        evals = {}
+        d = lgb.Dataset(tp)
+        lgb.train({"objective": obj, "num_leaves": 15,
+                   "metric": "xentlambda", "verbose": -1},
+                  d, num_boost_round=5, valid_sets=[d],
+                  valid_names=["training"],
+                  callbacks=[lgb.record_evaluation(evals)])
+        ours = evals["training"]["xentlambda"][-1]
+        assert abs(ours - ref_val) < 1e-5, (obj, ours, ref_val)
