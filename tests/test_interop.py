@@ -273,19 +273,23 @@ def test_objective_sweep_training_parity(ref_bin, tmp_path):
     binc = "/root/reference/examples/binary_classification/binary.train"
     if not (os.path.exists(reg) and os.path.exists(binc)):
         pytest.skip("reference example data missing")
-    cases = [(reg, "regression"), (reg, "regression_l1"), (reg, "huber"),
-             (reg, "fair"), (reg, "poisson"),
-             (binc, "binary"), (binc, "xentropy"), (binc, "xentlambda")]
-    for data_path, obj in cases:
+    cases = [(reg, "regression", {}), (reg, "regression_l1", {}),
+             (reg, "huber", {}), (reg, "fair", {}),
+             (reg, "poisson", {}),
+             (reg, "poisson", {"poisson_max_delta_step": 0.3}),
+             (binc, "binary", {}), (binc, "binary", {"sigmoid": 2.0}),
+             (binc, "xentropy", {}), (binc, "xentlambda", {})]
+    for data_path, obj, extra in cases:
         ours = lgb.train({"objective": obj, "num_leaves": 15,
-                          "min_data_in_leaf": 20, "verbose": -1},
+                          "min_data_in_leaf": 20, "verbose": -1, **extra},
                          lgb.Dataset(data_path), num_boost_round=6)
         model_path = tmp_path / "sweep_ref.txt"
         conf = tmp_path / "sweep.conf"
         conf.write_text(
             f"task=train\nobjective={obj}\ndata={data_path}\nnum_trees=6\n"
             "num_leaves=15\nmin_data_in_leaf=20\n"
-            f"output_model={model_path}\nverbosity=-1\n")
+            + "".join(f"{k}={v}\n" for k, v in extra.items())
+            + f"output_model={model_path}\nverbosity=-1\n")
         subprocess.run([ref_bin, f"config={conf}"], check=True,
                        capture_output=True, timeout=300)
         ref = lgb.Booster(model_file=str(model_path))
