@@ -13,12 +13,17 @@ Shape knobs (the reference's other headline datasets):
                         (Bosch-style sparse regime, GPU-Performance.md:112)
 
 Baseline: the reference v2.0.5 CLI measured on THIS host (1 CPU core,
-identical synthetic data/config at 1M rows): 0.4283 s/tree = 2.336 trees/s.
-The published numbers use a 28-core Xeon; we scale the measured single-core
-throughput linearly by 28 (optimistic for the CPU — LightGBM scales
-sublinearly) to get a conservative stand-in: 65.4 trees/s at 1M rows x 28
-features.  Histogram cost is linear in rows x features, so the baseline
-scales by (1M / BENCH_ROWS) * (28 / BENCH_FEATURES) for other shapes;
+identical synthetic data/config at 1M rows, marginal cost of trees 2-11 so
+load/bin time cancels — scripts/measure_ref_baseline.py, result committed
+in docs/ref_baseline_measured.json): 0.3955 s/tree = 2.5285 trees/s.  The
+host exposes exactly one CPU, so the published 28-thread rig
+(docs/GPU-Performance.md:101-117) cannot be measured here (num_threads=28
+on one core was measured too: 1.60 trees/s — oversubscription hurts); we
+scale the measured single-core throughput linearly by 28 (optimistic for
+the CPU — LightGBM scales sublinearly) to get a conservative stand-in:
+70.8 trees/s at 1M rows x 28 features.  Histogram cost is linear in
+rows x features, so the baseline scales by
+(1M / BENCH_ROWS) * (28 / BENCH_FEATURES) for other shapes;
 BENCH_BASELINE_TPS overrides with a directly measured number (e.g. from the
 interop-built reference CLI).  ``vs_baseline`` = our trees/s / that.
 
@@ -41,7 +46,7 @@ import subprocess
 import sys
 import time
 
-BASELINE_TREES_PER_SEC_1M = 2.336 * 28  # see module docstring
+BASELINE_TREES_PER_SEC_1M = 2.5285 * 28  # see module docstring
 
 
 def make_data(n, f=28, sparsity=0.0, seed=42):

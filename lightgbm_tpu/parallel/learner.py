@@ -170,10 +170,20 @@ class VotingStrategy(SerialStrategy):
     """
 
     def __init__(self, cfg: GrowerConfig, axis_name: str = "data",
-                 top_k: int = 20):
+                 top_k: int = 20, num_shards: int = 1):
         super().__init__(cfg)
         self.axis = axis_name
         self.top_k = top_k
+        # the LOCAL vote scan sees ~1/S of every leaf's rows, so the data /
+        # hessian gates must shrink with the shard count or features stop
+        # voting long before the leaf is globally unsplittable
+        # (voting_parallel_tree_learner.cpp:54-56 divides both by
+        # num_machines; integer division for the count, float for the
+        # hessian).  The GLOBAL find on the psum-reduced histograms keeps
+        # the unscaled config.
+        self.local_scfg = cfg.split_config()._replace(
+            min_data_in_leaf=cfg.min_data_in_leaf // num_shards,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf / num_shards)
 
     def reduce_scalar(self, x):
         return lax.psum(x, self.axis)
@@ -208,7 +218,7 @@ class VotingStrategy(SerialStrategy):
         pc_loc = hist_child[:, :, 2].sum(axis=1, keepdims=True)
         local_gain = per_feature_best_gain(
             hist_child, pg_loc, ph_loc, pc_loc, meta.num_bin,
-            meta.missing_type, meta.default_bin, feat_valid, scfg,
+            meta.missing_type, meta.default_bin, feat_valid, self.local_scfg,
             is_cat=meta.is_categorical)
         _, local_top = lax.top_k(local_gain, k)
         gathered = lax.all_gather(
@@ -259,7 +269,7 @@ def make_distributed_grower(cfg: GrowerConfig, mesh: Mesh,
         in_row = P(axis)
         row_out = P(axis)
     elif tree_learner == "voting":
-        strategy = VotingStrategy(cfg, axis, top_k)
+        strategy = VotingStrategy(cfg, axis, top_k, num_shards=n_shards)
         in_row = P(axis)
         row_out = P(axis)
     elif tree_learner == "feature":

@@ -1,0 +1,108 @@
+"""Measure the reference CLI's training throughput on THIS host.
+
+Feeds the reference binary (.refbuild/lightgbm, built -O3 + OpenMP) the
+exact bench.py synthetic workload (1M x 28, binary, 255 leaves / 255
+bins) and reports marginal trees/sec: wall(11 trees) - wall(1 tree)
+over 10, so dataset load + bin construction cancels out.
+
+Context (VERDICT r3 item 7 asked for a *measured multi-core* baseline):
+this host exposes exactly ONE CPU (nproc=1, cgroup cpu.max unlimited but
+a single hart), so the published 28-thread configuration
+(reference docs/GPU-Performance.md:101-117) cannot be reproduced here.
+The honest measurable number is the single-core throughput; bench.py's
+28x linear extrapolation remains the stand-in for the published rig and
+is *optimistic for the CPU* (LightGBM scales sublinearly in threads).
+We additionally run num_threads=28 on the single core to document that
+oversubscription does not beat num_threads=1.
+
+Writes docs/ref_baseline_measured.json and prints it.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import make_data  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, ".refbuild", "lightgbm")
+
+CONF = """task=train
+objective=binary
+num_leaves=255
+max_bin=255
+min_data_in_leaf=1
+min_sum_hessian_in_leaf=100
+learning_rate=0.1
+verbosity=-1
+data={data}
+num_trees={trees}
+num_threads={threads}
+output_model={model}
+"""
+
+
+def run_cli(conf_path):
+    t0 = time.perf_counter()
+    r = subprocess.run([CLI, f"config={conf_path}"], capture_output=True,
+                       text=True, timeout=3600)
+    dt = time.perf_counter() - t0
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-2000:] + "\n")
+        raise RuntimeError(f"reference CLI rc={r.returncode}")
+    return dt
+
+
+def measure(data_path, tmpdir, threads):
+    walls = {}
+    for trees in (1, 11):
+        conf = os.path.join(tmpdir, f"t{threads}_{trees}.conf")
+        with open(conf, "w") as f:
+            f.write(CONF.format(data=data_path, trees=trees, threads=threads,
+                                model=os.path.join(tmpdir, "model.txt")))
+        walls[trees] = run_cli(conf)
+        sys.stderr.write(f"threads={threads} trees={trees}: "
+                         f"{walls[trees]:.1f}s wall\n")
+    marginal = (walls[11] - walls[1]) / 10.0
+    return {"threads": threads, "wall_1_tree_s": round(walls[1], 2),
+            "wall_11_trees_s": round(walls[11], 2),
+            "s_per_tree": round(marginal, 4),
+            "trees_per_sec": round(1.0 / marginal, 4)}
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    X, y = make_data(n_rows, 28)
+    tmpdir = tempfile.mkdtemp(prefix="refbase_")
+    try:
+        data_path = os.path.join(tmpdir, "train.csv")
+        t0 = time.perf_counter()
+        import numpy as np
+        np.savetxt(data_path, np.column_stack([y, X]), delimiter=",",
+                   fmt="%.6g")
+        sys.stderr.write(f"csv write {time.perf_counter() - t0:.1f}s\n")
+
+        out = {
+            "host_cpus": os.cpu_count(),
+            "rows": n_rows, "features": 28,
+            "config": "binary, 255 leaves, 255 bins, min_data=1, "
+                      "min_hess=100",
+            "runs": [measure(data_path, tmpdir, 1),
+                     measure(data_path, tmpdir, 28)],
+            "note": ("host has 1 CPU; the 28-thread run documents "
+                     "oversubscription, not the published 28-core rig"),
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    dest = os.path.join(REPO, "docs", "ref_baseline_measured.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -61,8 +61,33 @@ def test_voting_parallel_quality(data):
     auc_vote, _ = _train_auc(X, y, Xt, yt, {"tree_learner": "voting",
                                             "top_k": 10})
     # voting is an approximation (communication compression) — quality must
-    # stay close but not bit-identical
-    assert auc_vote == pytest.approx(auc_serial, abs=2e-2)
+    # stay close but not bit-identical (measured delta with scaled local
+    # constraints: 1.2e-3)
+    assert auc_vote == pytest.approx(auc_serial, abs=5e-3)
+
+
+def test_voting_local_constraint_scaling(data):
+    """The LOCAL vote scan must divide min_data_in_leaf /
+    min_sum_hessian_in_leaf by the shard count
+    (voting_parallel_tree_learner.cpp:54-56): with 8 shards each holding
+    ~1/8 of every leaf's rows, an unscaled gate stops features from voting
+    on leaves that are globally splittable — here min_data_in_leaf=320 vs
+    875 local rows at the root freezes the whole tree after one level
+    (a leaf of ~440 local rows cannot produce two ≥320-row children), so
+    unscaled code grows ≤3 leaves and this test fails."""
+    X, y, Xt, yt = data
+    extra = {"min_data_in_leaf": 320, "num_leaves": 12}
+    auc_serial, bst_s = _train_auc(X, y, Xt, yt,
+                                   {"tree_learner": "serial", **extra})
+    auc_vote, bst_v = _train_auc(X, y, Xt, yt,
+                                 {"tree_learner": "voting", "top_k": 10,
+                                  **extra})
+    leaves_s = bst_s.inner.models[0].num_leaves
+    leaves_v = bst_v.inner.models[0].num_leaves
+    assert leaves_s > 6, "problem setup: serial must actually grow"
+    # voting may stop a vote-starved leaf slightly early, never collapse
+    assert leaves_v >= leaves_s - 2
+    assert auc_vote == pytest.approx(auc_serial, abs=6e-3)
 
 
 def _bundled_problem(n=3000, groups=3, cats=6, dense=2, n_valid=1000, seed=7):
