@@ -1,0 +1,194 @@
+# lgb.Booster: environment-backed S3 model object.
+#
+# API surface of the reference's R6 Booster
+# (R-package/R/lgb.Booster.R:1-871) on the file transport: the object
+# owns the model text (the reference's exchange format) plus recorded
+# evaluation history; predict shells out to the CLI.  Because the state
+# is plain R data — no external pointers — saveRDS/readRDS work
+# natively; the reference's special raw-save dance is unnecessary.
+
+.lgbtpu_new_booster <- function(model_string, params = list(),
+                                record_evals = list(), best_iter = -1L,
+                                best_score = NA_real_) {
+  env <- new.env(parent = emptyenv())
+  env$model_string <- model_string
+  env$params <- params
+  env$record_evals <- record_evals
+  env$best_iter <- best_iter
+  env$best_score <- best_score
+  structure(env, class = "lgb.Booster")
+}
+
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  if (is.null(filename) && is.null(model_str)) {
+    stop("lgb.load: either filename or model_str must be given")
+  }
+  model_string <- if (!is.null(filename)) {
+    if (!file.exists(filename)) stop("lgb.load: file does not exist: ",
+                                     filename)
+    readLines(filename)
+  } else {
+    strsplit(paste(model_str, collapse = "\n"), "\n", fixed = TRUE)[[1]]
+  }
+  .lgbtpu_new_booster(model_string)
+}
+
+lgb.save <- function(booster, filename, num_iteration = NULL) {
+  if (!lgb.is.Booster(booster)) {
+    stop("lgb.save: booster should be an lgb.Booster")
+  }
+  writeLines(.lgbtpu_model_text(booster, num_iteration), filename)
+  invisible(booster)
+}
+
+# Model text, optionally truncated to the first num_iteration iterations
+# (Booster$save_model(num_iteration) semantics; best_iter when -1 is
+# requested mirrors the reference's SaveModelToFile contract).  A
+# boost_from_average model carries one extra init tree before the
+# boosted trees (boosting.py save_model_to_string: (num_iteration + 1)
+# * num_class trees kept) — the "boost_from_average" header line flags
+# it.
+.lgbtpu_has_init_tree <- function(lines) {
+  any(lines == "boost_from_average")
+}
+
+.lgbtpu_model_text <- function(booster, num_iteration = NULL) {
+  lines <- booster$model_string
+  if (is.null(num_iteration)) return(lines)
+  if (num_iteration <= 0) {
+    num_iteration <- if (booster$best_iter > 0) booster$best_iter
+                     else .lgbtpu_num_trees(booster)
+  }
+  nc <- .lgbtpu_num_class(lines)
+  keep_trees <- (num_iteration + .lgbtpu_has_init_tree(lines)) * nc
+  starts <- grep("^Tree=", lines)
+  if (length(starts) <= keep_trees) return(lines)
+  trailer <- grep("^feature importances:", lines)
+  cut <- starts[keep_trees + 1]
+  head_part <- lines[1:(cut - 1)]
+  if (length(trailer)) {
+    head_part <- c(head_part, lines[trailer[1]:length(lines)])
+  }
+  head_part
+}
+
+.lgbtpu_num_trees <- function(booster) {
+  lines <- booster$model_string
+  n <- length(grep("^Tree=", lines)) - .lgbtpu_has_init_tree(lines)
+  nc <- .lgbtpu_num_class(lines)
+  as.integer(n / max(nc, 1L))
+}
+
+lgb.dump <- function(booster, num_iteration = NULL) {
+  if (!lgb.is.Booster(booster)) {
+    stop("lgb.dump: booster should be an lgb.Booster")
+  }
+  work <- .lgbtpu_tmpdir("lgbtpu_dump_")
+  on.exit(unlink(work, recursive = TRUE), add = TRUE)
+  model_file <- file.path(work, "model.txt")
+  writeLines(.lgbtpu_model_text(booster, num_iteration), model_file)
+  out_file <- file.path(work, "model.json")
+  .lgbtpu_run(c("task=dump_model",
+                paste0("input_model=", model_file),
+                paste0("convert_model=", out_file)))
+  paste(readLines(out_file), collapse = "\n")
+}
+
+predict.lgb.Booster <- function(object, data,
+                                num_iteration = NULL,
+                                rawscore = FALSE,
+                                predleaf = FALSE,
+                                header = FALSE,
+                                reshape = FALSE, ...) {
+  if (!lgb.is.Booster(object)) {
+    stop("predict.lgb.Booster: object should be an ", sQuote("lgb.Booster"))
+  }
+  work <- .lgbtpu_tmpdir("lgbtpu_pred_")
+  on.exit(unlink(work, recursive = TRUE), add = TRUE)
+  data_file <- file.path(work, "pred.tsv")
+  if (is.character(data) && length(data) == 1) {
+    data_file <- data
+  } else {
+    .lgbtpu_write_data(data, NULL, data_file)
+  }
+  model_file <- file.path(work, "model.txt")
+  writeLines(object$model_string, model_file)
+  out_file <- file.path(work, "pred_out.txt")
+  args <- c("task=predict",
+            paste0("data=", data_file),
+            paste0("input_model=", model_file),
+            paste0("output_result=", out_file),
+            paste0("header=", if (header) "true" else "false"),
+            paste0("predict_raw_score=", if (rawscore) "true" else "false"),
+            paste0("predict_leaf_index=", if (predleaf) "true" else "false"))
+  if (!is.null(num_iteration)) {
+    args <- c(args, paste0("num_iteration_predict=",
+                           as.integer(num_iteration)))
+  }
+  .lgbtpu_run(args)
+  out <- as.matrix(utils::read.table(out_file, header = FALSE))
+  dimnames(out) <- NULL
+  if (predleaf) {
+    storage.mode(out) <- "integer"
+    return(out)
+  }
+  if (ncol(out) == 1) return(as.numeric(out[, 1]))
+  if (reshape) return(out)
+  # reference contract (lgb.Booster.R predict): multiclass output is a
+  # flat row-major vector [r0c0, r0c1, ..., r1c0, ...] unless reshape
+  as.numeric(t(out))
+}
+
+lgb.get.eval.result <- function(booster, data_name, eval_name, iters = NULL,
+                                is_err = FALSE) {
+  if (!lgb.is.Booster(booster)) {
+    stop("lgb.get.eval.result: booster should be an lgb.Booster")
+  }
+  rec <- booster$record_evals[[data_name]]
+  if (is.null(rec)) {
+    stop("lgb.get.eval.result: no record for data_name ", sQuote(data_name),
+         "; recorded: ", paste(names(booster$record_evals), collapse = ", "))
+  }
+  entry <- rec[[eval_name]]
+  if (is.null(entry)) {
+    stop("lgb.get.eval.result: no metric ", sQuote(eval_name),
+         " for ", sQuote(data_name),
+         "; recorded: ", paste(names(rec), collapse = ", "))
+  }
+  values <- if (is.list(entry)) {
+    unlist(if (is_err) entry$eval_err else entry$eval)
+  } else {
+    if (is_err) stop("lgb.get.eval.result: no error (sd) recorded")
+    entry
+  }
+  if (!is.null(iters)) values <- values[iters]
+  values
+}
+
+print.lgb.Booster <- function(x, ...) {
+  cat("lgb.Booster (lightgbm_tpu):", .lgbtpu_num_trees(x), "iterations")
+  nc <- .lgbtpu_num_class(x$model_string)
+  if (nc > 1) cat(",", nc, "classes")
+  if (x$best_iter > 0) cat(", best_iter", x$best_iter)
+  cat("\n")
+  invisible(x)
+}
+
+# The reference needs these wrappers because its Booster holds an
+# external pointer that does not survive serialization
+# (R-package/R/saveRDS.lgb.Booster.R); ours is plain data, so they are
+# thin compatibility shims.
+saveRDS.lgb.Booster <- function(object, file = "", ascii = FALSE,
+                                version = NULL, compress = TRUE,
+                                refhook = NULL, raw = TRUE) {
+  saveRDS(object, file = file, ascii = ascii, version = version,
+          compress = compress, refhook = refhook)
+}
+
+readRDS.lgb.Booster <- function(file = "", refhook = NULL) {
+  obj <- readRDS(file = file, refhook = refhook)
+  if (!lgb.is.Booster(obj)) {
+    stop("readRDS.lgb.Booster: file does not contain an lgb.Booster")
+  }
+  obj
+}

@@ -1,0 +1,191 @@
+# lgb.Dataset: environment-backed S3 dataset object.
+#
+# API surface of the reference's R6 Dataset
+# (R-package/R/lgb.Dataset.R:644-1085) on a file transport: the object
+# holds the raw matrix plus metadata (label / weight / group /
+# init_score, categorical features, colnames, params) and materializes
+# reference-format data + side files on construct().
+
+lgb.Dataset <- function(data,
+                        params = list(),
+                        reference = NULL,
+                        colnames = NULL,
+                        categorical_feature = NULL,
+                        free_raw_data = TRUE,
+                        info = list(),
+                        ...) {
+  info <- modifyList(info, list(...))
+  env <- new.env(parent = emptyenv())
+  env$raw_data <- data
+  env$params <- params
+  env$reference <- reference
+  env$colnames <- colnames
+  env$categorical_feature <- categorical_feature
+  env$free_raw_data <- free_raw_data
+  env$info <- info
+  env$constructed_path <- NULL
+  env$version <- 0L
+  if (is.null(env$colnames) && is.matrix(data) && !is.null(colnames(data))) {
+    env$colnames <- colnames(data)
+  }
+  structure(env, class = "lgb.Dataset")
+}
+
+lgb.Dataset.create.valid <- function(dataset, data, info = list(), ...) {
+  if (!lgb.is.Dataset(dataset)) {
+    stop("lgb.Dataset.create.valid: input data should be an lgb.Dataset ",
+         "object")
+  }
+  valid <- lgb.Dataset(data,
+                       params = dataset$params,
+                       reference = dataset,
+                       colnames = dataset$colnames,
+                       categorical_feature = dataset$categorical_feature,
+                       free_raw_data = dataset$free_raw_data,
+                       info = modifyList(info, list(...)))
+  valid
+}
+
+# Materialize the dataset as reference-format files in `dir`; returns
+# the data path.  Side files follow src/io/metadata.cpp conventions.
+.lgbtpu_construct_in <- function(dataset, dir, name = "data") {
+  path <- file.path(dir, paste0(name, ".tsv"))
+  has_side <- !is.null(dataset$info$weight) ||
+    !is.null(dataset$info$group) || !is.null(dataset$info$init_score)
+  if (is.character(dataset$raw_data) && length(dataset$raw_data) == 1) {
+    if (has_side) {
+      # copy into the work dir so side files never land (or clobber
+      # anything) next to the user's own data file
+      file.copy(dataset$raw_data, path, overwrite = TRUE)
+    } else {
+      path <- dataset$raw_data    # user-supplied file: use in place
+    }
+  } else {
+    .lgbtpu_write_data(dataset$raw_data, dataset$info$label, path)
+  }
+  .lgbtpu_write_side(path, "weight", dataset$info$weight)
+  .lgbtpu_write_side(path, "query", dataset$info$group)
+  .lgbtpu_write_side(path, "init", dataset$info$init_score)
+  dataset$constructed_path <- path
+  path
+}
+
+lgb.Dataset.construct <- function(dataset) {
+  if (!lgb.is.Dataset(dataset)) {
+    stop("lgb.Dataset.construct: input data should be an lgb.Dataset object")
+  }
+  if (is.null(dataset$constructed_path)) {
+    .lgbtpu_construct_in(dataset, .lgbtpu_tmpdir("lgbtpu_ds_"))
+  }
+  invisible(dataset)
+}
+
+dim.lgb.Dataset <- function(x, ...) {
+  if (is.character(x$raw_data)) {
+    stop("dim: cannot get dimensions of a file-backed lgb.Dataset before ",
+         "training")
+  }
+  dim(as.matrix(x$raw_data))
+}
+
+dimnames.lgb.Dataset <- function(x) {
+  list(NULL, x$colnames)
+}
+
+`dimnames<-.lgb.Dataset` <- function(x, value) {
+  if (!is.list(value) || length(value) != 2) {
+    stop("invalid dimnames: must be a list of length 2")
+  }
+  if (!is.null(value[[2]]) &&
+      length(value[[2]]) != dim(x)[2]) {
+    stop("invalid dimnames: column name length mismatch")
+  }
+  x$colnames <- value[[2]]
+  x
+}
+
+slice <- function(dataset, ...) UseMethod("slice")
+
+slice.lgb.Dataset <- function(dataset, idxset, ...) {
+  if (is.character(dataset$raw_data)) {
+    stop("slice: cannot slice a file-backed lgb.Dataset")
+  }
+  info <- dataset$info
+  for (k in c("label", "weight", "init_score")) {
+    if (!is.null(info[[k]])) info[[k]] <- info[[k]][idxset]
+  }
+  if (!is.null(info$group)) {
+    stop("slice: slicing grouped (ranking) data is not supported; ",
+         "re-create the lgb.Dataset from the sliced rows and groups")
+  }
+  lgb.Dataset(as.matrix(dataset$raw_data)[idxset, , drop = FALSE],
+              params = dataset$params,
+              colnames = dataset$colnames,
+              categorical_feature = dataset$categorical_feature,
+              free_raw_data = dataset$free_raw_data,
+              info = info)
+}
+
+getinfo <- function(dataset, ...) UseMethod("getinfo")
+
+getinfo.lgb.Dataset <- function(dataset, name, ...) {
+  if (!is.character(name) || length(name) != 1) {
+    stop("getinfo: name must be one of 'label', 'weight', 'group', ",
+         "'init_score'")
+  }
+  dataset$info[[name]]
+}
+
+setinfo <- function(dataset, ...) UseMethod("setinfo")
+
+setinfo.lgb.Dataset <- function(dataset, name, info, ...) {
+  if (!name %in% c("label", "weight", "group", "init_score")) {
+    stop("setinfo: name must be one of 'label', 'weight', 'group', ",
+         "'init_score'")
+  }
+  dataset$info[[name]] <- info
+  dataset$constructed_path <- NULL   # invalidate materialized files
+  invisible(dataset)
+}
+
+lgb.Dataset.set.categorical <- function(dataset, categorical_feature) {
+  if (!lgb.is.Dataset(dataset)) {
+    stop("lgb.Dataset.set.categorical: input data should be an lgb.Dataset ",
+         "object")
+  }
+  dataset$categorical_feature <- categorical_feature
+  dataset$constructed_path <- NULL
+  invisible(dataset)
+}
+
+lgb.Dataset.set.reference <- function(dataset, reference) {
+  if (!lgb.is.Dataset(dataset) || !lgb.is.Dataset(reference)) {
+    stop("lgb.Dataset.set.reference: both arguments must be lgb.Dataset ",
+         "objects")
+  }
+  dataset$reference <- reference
+  dataset$categorical_feature <- reference$categorical_feature
+  dataset$colnames <- reference$colnames
+  invisible(dataset)
+}
+
+lgb.Dataset.save <- function(dataset, fname) {
+  if (!lgb.is.Dataset(dataset)) {
+    stop("lgb.Dataset.save: input data should be an lgb.Dataset object")
+  }
+  .lgbtpu_write_data(dataset$raw_data, dataset$info$label, fname)
+  .lgbtpu_write_side(fname, "weight", dataset$info$weight)
+  .lgbtpu_write_side(fname, "query", dataset$info$group)
+  .lgbtpu_write_side(fname, "init", dataset$info$init_score)
+  invisible(dataset)
+}
+
+print.lgb.Dataset <- function(x, ...) {
+  if (is.character(x$raw_data)) {
+    cat("lgb.Dataset (file-backed):", x$raw_data, "\n")
+  } else {
+    d <- dim(x)
+    cat("lgb.Dataset:", d[1], "rows x", d[2], "features\n")
+  }
+  invisible(x)
+}

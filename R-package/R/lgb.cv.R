@@ -1,0 +1,197 @@
+# lgb.cv: reference-compatible cross-validation
+# (R-package/R/lgb.cv.R:81-304 surface) over the CLI transport.
+#
+# Each fold trains a full CLI run; per-iteration metrics are merged to
+# mean +/- sd exactly like lgb.merge.cv.result (lgb.cv.R:430-475).
+# early_stopping_rounds is applied to the merged mean curve after the
+# folds finish — the selected best_iter matches the reference's
+# in-the-loop stopping; only the wasted tail-training differs.
+
+lgb.cv <- function(params = list(),
+                   data,
+                   nrounds = 10,
+                   nfold = 3,
+                   label = NULL,
+                   weight = NULL,
+                   obj = NULL,
+                   eval = NULL,
+                   verbose = 1,
+                   record = TRUE,
+                   eval_freq = 1L,
+                   showsd = TRUE,
+                   stratified = TRUE,
+                   folds = NULL,
+                   init_model = NULL,
+                   colnames = NULL,
+                   categorical_feature = NULL,
+                   early_stopping_rounds = NULL,
+                   callbacks = list(),
+                   ...) {
+  params <- append(params, list(...))
+  if (!lgb.is.Dataset(data)) {
+    if (is.null(label)) {
+      stop("lgb.cv: data must be an lgb.Dataset, or supply label= with a ",
+           "matrix")
+    }
+    data <- lgb.Dataset(data, info = list(label = label, weight = weight))
+  }
+  if (is.character(data$raw_data)) {
+    stop("lgb.cv: file-backed datasets cannot be fold-sliced; load the ",
+         "data into a matrix first")
+  }
+  y <- data$info$label
+  n <- nrow(as.matrix(data$raw_data))
+  if (is.null(folds)) {
+    folds <- generate.cv.folds(nfold, n, stratified, y,
+                               data$info$group, params)
+  }
+  nfold <- length(folds)
+
+  per_fold <- vector("list", nfold)
+  boosters <- vector("list", nfold)
+  for (k in seq_len(nfold)) {
+    test_idx <- folds[[k]]
+    train_idx <- setdiff(seq_len(n), test_idx)
+    pair <- .lgbtpu_cv_split(data, train_idx, test_idx)
+    dtrain <- pair$train
+    dvalid <- pair$valid
+    bst <- lgb.train(params = params, data = dtrain, nrounds = nrounds,
+                     valids = list(valid = dvalid), obj = obj, eval = eval,
+                     verbose = 0, record = TRUE, eval_freq = 1L,
+                     init_model = init_model, colnames = colnames,
+                     categorical_feature = categorical_feature)
+    per_fold[[k]] <- bst$record_evals[["valid"]]
+    boosters[[k]] <- list(booster = bst)
+  }
+
+  # merge: mean + sd across folds per metric per iteration
+  metrics <- names(per_fold[[1]])
+  record_evals <- list(valid = list())
+  for (m in metrics) {
+    vals <- sapply(per_fold, function(r) unlist(r[[m]]$eval))  # [iter, fold]
+    vals <- matrix(vals, ncol = nfold)
+    means <- rowMeans(vals)
+    sds <- apply(vals, 1, stats::sd)
+    record_evals$valid[[m]] <- list(eval = as.list(means),
+                                    eval_err = as.list(sds))
+  }
+
+  cvm <- new.env(parent = emptyenv())
+  cvm$boosters <- boosters
+  cvm$record_evals <- if (record) record_evals else list()
+  cvm$best_iter <- -1L
+  cvm$best_score <- NA_real_
+  if (length(metrics)) {
+    first <- metrics[1]
+    means <- unlist(record_evals$valid[[first]]$eval)
+    higher_better <- .lgbtpu_higher_better(first)
+    best <- if (higher_better) which.max(means) else which.min(means)
+    if (!is.null(early_stopping_rounds)) {
+      # first iteration whose following early_stopping_rounds iterations
+      # fail to improve (the reference's cb.early.stop over fold means)
+      run_best <- if (higher_better) cummax(means) else cummin(means)
+      stall <- which(seq_along(means) - match(run_best, run_best) >=
+                       early_stopping_rounds)
+      if (length(stall)) {
+        best <- match(run_best[stall[1]], means)
+      }
+    }
+    cvm$best_iter <- as.integer(best)
+    cvm$best_score <- means[best]
+  }
+  if (verbose > 0 && length(metrics)) {
+    for (i in seq(1, nrounds, by = max(1L, as.integer(eval_freq)))) {
+      parts <- vapply(metrics, function(m) {
+        e <- record_evals$valid[[m]]
+        if (i > length(e$eval)) return(NA_character_)
+        sprintf("valid %s: %g%s", m, e$eval[[i]],
+                if (showsd) sprintf(" + %g", e$eval_err[[i]]) else "")
+      }, character(1))
+      parts <- parts[!is.na(parts)]
+      if (length(parts)) cat(sprintf("[%d]\t%s\n", i,
+                                     paste(parts, collapse = "\t")))
+    }
+  }
+  structure(cvm, class = "lgb.CVBooster")
+}
+
+# Reference generate.cv.folds / lgb.stratified.folds (lgb.cv.R:306-428)
+# in base R: stratified folds shuffle within sorted-label groups;
+# grouped (ranking) data folds whole query groups.
+generate.cv.folds <- function(nfold, nrows, stratified, label, group,
+                              params) {
+  if (nfold <= 1) stop("lgb.cv: nfold must be > 1")
+  if (!is.null(group)) {
+    ng <- length(group)
+    gfold <- sample(rep(seq_len(nfold), length.out = ng))
+    ends <- cumsum(group)
+    starts <- c(1, head(ends, -1) + 1)
+    return(lapply(seq_len(nfold), function(k) {
+      unlist(lapply(which(gfold == k),
+                    function(g) seq(starts[g], ends[g])))
+    }))
+  }
+  obj <- params$objective
+  can_stratify <- stratified && !is.null(label) &&
+    (is.null(obj) || obj %in% c("binary", "multiclass", "multiclassova",
+                                "cross_entropy", "xentropy"))
+  if (can_stratify) {
+    return(lgb.stratified.folds(label, nfold))
+  }
+  idx <- sample(nrows)
+  split(idx, rep(seq_len(nfold), length.out = nrows))
+}
+
+lgb.stratified.folds <- function(y, k = 10) {
+  # proportional allocation of each class across folds (caret-style,
+  # like the reference's lgb.stratified.folds)
+  fold_of <- integer(length(y))
+  for (cls in unique(y)) {
+    members <- which(y == cls)
+    fold_of[members] <- sample(rep(seq_len(k),
+                                   length.out = length(members)))
+  }
+  lapply(seq_len(k), function(f) which(fold_of == f))
+}
+
+# Fold split that understands query groups: for ranking data the folds
+# hold whole groups (generate.cv.folds), so each side keeps the group
+# sizes of its own groups in order; plain data goes through slice().
+.lgbtpu_cv_split <- function(data, train_idx, test_idx) {
+  grp <- data$info$group
+  if (is.null(grp)) {
+    return(list(train = slice(data, train_idx),
+                valid = slice(data, test_idx)))
+  }
+  row_group <- rep(seq_along(grp), times = grp)
+  make <- function(idx) {
+    idx <- sort(idx)
+    gids <- unique(row_group[idx])
+    if (!all(tabulate(row_group[idx], length(grp))[gids] == grp[gids])) {
+      stop("lgb.cv: ranking folds must contain whole query groups")
+    }
+    info <- data$info
+    for (f in c("label", "weight", "init_score")) {
+      if (!is.null(info[[f]])) info[[f]] <- info[[f]][idx]
+    }
+    info$group <- grp[gids]
+    lgb.Dataset(as.matrix(data$raw_data)[idx, , drop = FALSE],
+                params = data$params, colnames = data$colnames,
+                categorical_feature = data$categorical_feature,
+                info = info)
+  }
+  list(train = make(train_idx), valid = make(test_idx))
+}
+
+.lgbtpu_higher_better <- function(metric) {
+  any(startsWith(metric, c("auc", "ndcg", "map")))
+}
+
+print.lgb.CVBooster <- function(x, ...) {
+  cat("lgb.CVBooster:", length(x$boosters), "folds")
+  if (x$best_iter > 0) {
+    cat(", best_iter", x$best_iter, "best_score", x$best_score)
+  }
+  cat("\n")
+  invisible(x)
+}

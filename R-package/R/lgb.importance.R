@@ -1,0 +1,51 @@
+# lgb.importance: Gain / Cover / Frequency feature importance
+# (R-package/R/lgb.importance.R:38-68 surface) computed from the
+# per-node table in base R (the reference aggregates the same three
+# statistics with data.table).
+
+lgb.importance <- function(model, percentage = TRUE) {
+  if (!lgb.is.Booster(model)) {
+    stop("'model' has to be an object of class lgb.Booster")
+  }
+  dt <- lgb.model.dt.tree(model)
+  splits <- dt[!is.na(dt$split_index), , drop = FALSE]
+  empty <- data.frame(Feature = character(0), Gain = numeric(0),
+                      Cover = numeric(0), Frequency = numeric(0),
+                      stringsAsFactors = FALSE)
+  if (nrow(splits) == 0) return(empty)
+  gain <- tapply(splits$split_gain, splits$split_feature, sum)
+  cover <- tapply(splits$internal_count, splits$split_feature, sum)
+  freq <- tapply(rep(1L, nrow(splits)), splits$split_feature, sum)
+  imp <- data.frame(Feature = names(gain),
+                    Gain = as.numeric(gain),
+                    Cover = as.numeric(cover),
+                    Frequency = as.numeric(freq),
+                    stringsAsFactors = FALSE)
+  imp <- imp[order(imp$Gain, decreasing = TRUE), , drop = FALSE]
+  rownames(imp) <- NULL
+  if (percentage) {
+    imp$Gain <- imp$Gain / sum(imp$Gain)
+    imp$Cover <- imp$Cover / sum(imp$Cover)
+    imp$Frequency <- imp$Frequency / sum(imp$Frequency)
+  }
+  imp
+}
+
+# lgb.plot.importance (R-package/R/lgb.plot.importance.R surface): a
+# horizontal barplot of the top_n measure values in base graphics.
+lgb.plot.importance <- function(tree_imp, top_n = 10, measure = "Gain",
+                                left_margin = 10, cex = NULL) {
+  if (!measure %in% colnames(tree_imp)) {
+    stop("lgb.plot.importance: measure must be one of ",
+         paste(setdiff(colnames(tree_imp), "Feature"), collapse = ", "))
+  }
+  top <- utils::head(tree_imp[order(tree_imp[[measure]],
+                                    decreasing = TRUE), ], top_n)
+  top <- top[rev(seq_len(nrow(top))), , drop = FALSE]
+  old <- graphics::par(mar = c(4, left_margin, 2, 1))
+  on.exit(graphics::par(old), add = TRUE)
+  graphics::barplot(top[[measure]], names.arg = top$Feature, horiz = TRUE,
+                    las = 1, main = "Feature importance",
+                    xlab = measure, cex.names = cex)
+  invisible(top)
+}

@@ -1,0 +1,74 @@
+# lgb.model.dt.tree: flatten a model into a per-node table
+# (R-package/R/lgb.model.dt.tree.R surface; returns a base-R data.frame
+# with the same columns instead of a data.table — the package has no
+# hard dependency on data.table).  Parses the model TEXT directly
+# (tree.py / gbdt_model_text.cpp format) rather than the JSON dump, so
+# it needs no CLI round-trip.
+
+lgb.model.dt.tree <- function(model, num_iteration = NULL) {
+  if (!lgb.is.Booster(model)) {
+    stop("lgb.model.dt.tree: model has to be an object of class lgb.Booster")
+  }
+  lines <- .lgbtpu_model_text(model, num_iteration)
+  feat_names <- .lgbtpu_feature_names(lines)
+  trees <- .lgbtpu_parse_trees(lines)
+  rows <- list()
+  for (ti in seq_along(trees)) {
+    kv <- trees[[ti]]
+    nl <- as.integer(kv[["num_leaves"]])
+    sf <- as.integer(.lgbtpu_field_num(kv, "split_feature"))
+    gain <- .lgbtpu_field_num(kv, "split_gain")
+    thr <- .lgbtpu_field_num(kv, "threshold")
+    dec <- as.integer(.lgbtpu_field_num(kv, "decision_type"))
+    lc <- as.integer(.lgbtpu_field_num(kv, "left_child"))
+    rc <- as.integer(.lgbtpu_field_num(kv, "right_child"))
+    ival <- .lgbtpu_field_num(kv, "internal_value")
+    icnt <- .lgbtpu_field_num(kv, "internal_count")
+    lval <- .lgbtpu_field_num(kv, "leaf_value")
+    lcnt <- .lgbtpu_field_num(kv, "leaf_count")
+    lpar <- as.integer(.lgbtpu_field_num(kv, "leaf_parent"))
+    ni <- nl - 1L
+    node_parent <- rep(NA_integer_, max(ni, 0))
+    if (ni > 0) {
+      for (p in seq_len(ni)) {
+        for (child in c(lc[p], rc[p])) {
+          if (child >= 0) node_parent[child + 1L] <- p - 1L
+        }
+      }
+    }
+    if (ni > 0) {
+      rows[[length(rows) + 1]] <- data.frame(
+        tree_index = ti - 1L,
+        split_index = seq_len(ni) - 1L,
+        split_feature = feat_names[sf + 1L],
+        node_parent = node_parent,
+        leaf_index = NA_integer_,
+        leaf_parent = NA_integer_,
+        split_gain = gain,
+        threshold = thr,
+        decision_type = dec,
+        internal_value = ival,
+        internal_count = icnt,
+        leaf_value = NA_real_,
+        leaf_count = NA_integer_,
+        stringsAsFactors = FALSE)
+    }
+    rows[[length(rows) + 1]] <- data.frame(
+      tree_index = ti - 1L,
+      split_index = NA_integer_,
+      split_feature = "NA",
+      node_parent = NA_integer_,
+      leaf_index = seq_len(nl) - 1L,
+      leaf_parent = if (length(lpar)) lpar else rep(NA_integer_, nl),
+      split_gain = NA_real_,
+      threshold = NA_real_,
+      decision_type = NA_integer_,
+      internal_value = NA_real_,
+      internal_count = NA_integer_,
+      leaf_value = lval,
+      leaf_count = if (length(lcnt)) as.integer(lcnt)
+                   else rep(NA_integer_, nl),
+      stringsAsFactors = FALSE)
+  }
+  do.call(rbind, rows)
+}

@@ -1,0 +1,177 @@
+# lgb.train: reference-compatible training entry point
+# (R-package/R/lgb.train.R:60-253 surface) over the CLI transport.
+
+lgb.train <- function(params = list(),
+                      data,
+                      nrounds = 10,
+                      valids = list(),
+                      obj = NULL,
+                      eval = NULL,
+                      verbose = 1,
+                      record = TRUE,
+                      eval_freq = 1L,
+                      init_model = NULL,
+                      colnames = NULL,
+                      categorical_feature = NULL,
+                      early_stopping_rounds = NULL,
+                      callbacks = list(),
+                      reset_data = FALSE,
+                      ...) {
+  params <- append(params, list(...))
+  if (is.function(obj) || is.function(params$objective)) {
+    stop("lgb.train: custom objective functions cannot cross the CLI ",
+         "transport; use a built-in objective name or the Python package")
+  }
+  if (is.function(eval)) {
+    stop("lgb.train: custom eval functions cannot cross the CLI transport; ",
+         "use built-in metric names or the Python package")
+  }
+  if (length(callbacks)) {
+    stop("lgb.train: R-side callbacks cannot run inside the CLI process; ",
+         "use eval_freq / early_stopping_rounds / record instead")
+  }
+  if (!is.null(obj)) params$objective <- obj
+  if (!is.null(eval)) params$metric <- eval
+  if (!lgb.is.Dataset(data)) {
+    stop("lgb.train: data must be an lgb.Dataset object")
+  }
+  if (!is.null(colnames)) dimnames(data) <- list(NULL, colnames)
+  if (!is.null(categorical_feature)) {
+    lgb.Dataset.set.categorical(data, categorical_feature)
+  }
+
+  work <- .lgbtpu_tmpdir("lgbtpu_train_")
+  on.exit(unlink(work, recursive = TRUE), add = TRUE)
+  train_file <- .lgbtpu_construct_in(data, work, "train")
+
+  # validation sets: the CLI names them valid_1..n in argument order and
+  # the training set "training" (is_training_metric); remember the
+  # mapping back to the user's names for record_evals
+  name_map <- list()
+  vfiles <- character(0)
+  want_train_metric <- FALSE
+  if (length(valids)) {
+    vnames <- names(valids)
+    if (is.null(vnames) || any(!nzchar(vnames))) {
+      stop("lgb.train: valids must be a NAMED list of lgb.Dataset objects")
+    }
+    vi <- 0L
+    for (i in seq_along(valids)) {
+      v <- valids[[i]]
+      if (!lgb.is.Dataset(v)) {
+        stop("lgb.train: valids[[", i, "]] is not an lgb.Dataset")
+      }
+      if (identical(v, data)) {
+        want_train_metric <- TRUE
+        name_map[["training"]] <- vnames[i]
+      } else {
+        vi <- vi + 1L
+        vf <- .lgbtpu_construct_in(v, work, paste0("valid_", vi))
+        vfiles <- c(vfiles, vf)
+        name_map[[paste0("valid_", vi)]] <- vnames[i]
+      }
+    }
+  }
+
+  model_file <- file.path(work, "model.txt")
+  cat_idx <- .lgbtpu_cat_indices(data)
+  # record_evals is parsed from the engine's eval log, so the CLI must
+  # emit it (verbose=1) whenever recording is on — system2 captures the
+  # output, and only verbose >= 1 echoes it to the R console below
+  have_evals <- length(vfiles) > 0 || want_train_metric
+  cli_verbose <- if (verbose >= 1 || (record && have_evals)) 1 else -1
+  args <- c("task=train",
+            paste0("data=", train_file),
+            paste0("output_model=", model_file),
+            paste0("num_iterations=", as.integer(nrounds)),
+            paste0("verbose=", cli_verbose),
+            paste0("output_freq=", as.integer(eval_freq)),
+            .lgbtpu_params(params))
+  if (length(vfiles)) {
+    args <- c(args, paste0("valid_data=", paste(vfiles, collapse = ",")))
+  }
+  if (want_train_metric) args <- c(args, "is_training_metric=true")
+  if (!is.null(cat_idx)) {
+    args <- c(args, paste0("categorical_feature=",
+                           paste(cat_idx, collapse = ",")))
+  }
+  if (!is.null(early_stopping_rounds)) {
+    args <- c(args, paste0("early_stopping_round=",
+                           as.integer(early_stopping_rounds)))
+  }
+  if (!is.null(init_model)) {
+    init_file <- if (lgb.is.Booster(init_model)) {
+      f <- file.path(work, "init_model.txt")
+      lgb.save(init_model, f)
+      f
+    } else {
+      as.character(init_model)
+    }
+    args <- c(args, paste0("input_model=", init_file))
+  }
+
+  log <- .lgbtpu_run(args)
+  if (verbose >= 1) {
+    evals <- grep("\\[[0-9]+\\]\t", log, value = TRUE)
+    if (length(evals)) cat(evals, sep = "\n")
+  }
+  booster <- .lgbtpu_new_booster(readLines(model_file), params = params)
+  if (record) {
+    booster$record_evals <- .lgbtpu_record_evals(log, name_map)
+  }
+  es <- regmatches(log, regexec("best iteration is: \\[([0-9]+)\\]", log))
+  es <- Filter(length, es)
+  if (length(es)) {
+    booster$best_iter <- as.integer(es[[length(es)]][2])
+    first_set <- names(booster$record_evals)
+    if (length(first_set)) {
+      entry <- booster$record_evals[[first_set[1]]]
+      if (length(entry)) {
+        vals <- unlist(entry[[1]]$eval)
+        if (booster$best_iter <= length(vals)) {
+          booster$best_score <- vals[booster$best_iter]
+        }
+      }
+    }
+  }
+  booster
+}
+
+# 0-based categorical indices for the CLI from names or indices
+# (reference Dataset$set_categorical_feature accepts both).
+.lgbtpu_cat_indices <- function(dataset) {
+  cf <- dataset$categorical_feature
+  if (is.null(cf) || length(cf) == 0) return(NULL)
+  if (is.character(cf)) {
+    if (is.null(dataset$colnames)) {
+      stop("categorical_feature given by name but the dataset has no ",
+           "column names")
+    }
+    idx <- match(cf, dataset$colnames)
+    if (anyNA(idx)) {
+      stop("categorical_feature name(s) not found: ",
+           paste(cf[is.na(idx)], collapse = ", "))
+    }
+    idx - 1L
+  } else {
+    # reference convention: NUMERIC input is 1-based R column numbers
+    # (lgb.Dataset.R set_categorical_feature: categorical_feature - 1)
+    as.integer(cf) - 1L
+  }
+}
+
+# CLI eval log -> reference record_evals nesting:
+#   record_evals[[data_name]][[metric]]$eval      list of values
+#   record_evals[[data_name]][[metric]]$eval_err  list (empty: no sd)
+.lgbtpu_record_evals <- function(log, name_map) {
+  parsed <- .lgbtpu_parse_eval_log(log)
+  rec <- list()
+  for (cli_name in names(parsed$sets)) {
+    user <- name_map[[cli_name]]
+    if (is.null(user)) user <- cli_name
+    rec[[user]] <- lapply(parsed$sets[[cli_name]], function(v) {
+      list(eval = as.list(v), eval_err = list())
+    })
+  }
+  rec
+}

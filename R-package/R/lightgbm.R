@@ -1,0 +1,46 @@
+# lightgbm(): the simple-interface trainer
+# (R-package/R/lightgbm.R:6-63 surface).
+
+lightgbm <- function(data,
+                     label = NULL,
+                     weight = NULL,
+                     params = list(),
+                     nrounds = 10,
+                     verbose = 1,
+                     eval_freq = 1L,
+                     early_stopping_rounds = NULL,
+                     save_name = "lightgbm.model",
+                     init_model = NULL,
+                     callbacks = list(),
+                     ...) {
+  dtrain <- data
+  if (!lgb.is.Dataset(dtrain)) {
+    dtrain <- lgb.Dataset(data, info = list(label = label, weight = weight))
+  }
+  valids <- list()
+  if (verbose > 0) valids$train <- dtrain
+  booster <- lgb.train(params = params, data = dtrain, nrounds = nrounds,
+                       valids = valids, verbose = verbose,
+                       eval_freq = eval_freq,
+                       early_stopping_rounds = early_stopping_rounds,
+                       init_model = init_model, callbacks = callbacks, ...)
+  if (!is.null(save_name) && nzchar(save_name)) {
+    lgb.save(booster, save_name)
+  }
+  booster
+}
+
+# The reference's lgb.unloader detaches the package and frees C++
+# handles (R-package/R/lgb.unloader.R); with the file transport there
+# are no native handles, so only the optional object cleanup applies.
+lgb.unloader <- function(restore = TRUE, wipe = FALSE, envir = .GlobalEnv) {
+  if (wipe) {
+    objs <- ls(envir = envir)
+    drop <- objs[vapply(objs, function(o) {
+      x <- get(o, envir = envir)
+      lgb.is.Booster(x) || lgb.is.Dataset(x) || inherits(x, "lgb.CVBooster")
+    }, logical(1))]
+    rm(list = drop, envir = envir)
+  }
+  invisible(NULL)
+}

@@ -1,0 +1,165 @@
+# Internal transport + parsing helpers for the lightgbm_tpu R package.
+#
+# Architecture: the package binds the `lightgbm-tpu` CLI over the
+# reference's own stable TEXT formats — data files, key=value config
+# args, `.weight`/`.query`/`.init` side files and model files — using
+# base R only.  The reference R-package binds its C API in-process
+# (src/lightgbm_R.cpp); here training runs on a TPU-backed Python
+# runtime, so a file transport is the honest process boundary.  Models
+# produced here load in the Python package, the reference CLI and the
+# reference R package unchanged, and vice versa.
+
+.lgbtpu_bin <- function() {
+  bin <- Sys.getenv("LIGHTGBM_TPU_BIN", "lightgbm-tpu")
+  if (Sys.which(bin) == "" && !file.exists(bin)) {
+    stop("lightgbm-tpu CLI not found; install the python package ",
+         "(pip install lightgbm_tpu) or set LIGHTGBM_TPU_BIN")
+  }
+  bin
+}
+
+.lgbtpu_run <- function(args) {
+  bin <- .lgbtpu_bin()
+  out <- system2(bin, args = shQuote(args), stdout = TRUE, stderr = TRUE)
+  code <- attr(out, "status")
+  if (!is.null(code) && code != 0) {
+    stop("lightgbm-tpu failed (exit ", code, "):\n",
+         paste(utils::tail(out, 20), collapse = "\n"))
+  }
+  invisible(out)
+}
+
+.lgbtpu_tmpdir <- function(prefix = "lgbtpu_") {
+  work <- tempfile(prefix)
+  dir.create(work)
+  work
+}
+
+# Write a feature matrix (+ optional label) in the reference TSV
+# convention: label first column, no header, NA -> "nan".
+.lgbtpu_write_data <- function(data, label, path) {
+  if (is.character(data) && length(data) == 1) {
+    # already a file in a reference-readable format
+    file.copy(data, path, overwrite = TRUE)
+    return(invisible(path))
+  }
+  data <- as.matrix(data)
+  if (!is.numeric(data)) {
+    stop("feature data must be numeric; encode factors/characters first ",
+         "(e.g. with model.matrix or as.integer on factor levels)")
+  }
+  storage.mode(data) <- "double"
+  if (is.null(label)) {
+    label <- rep(0, nrow(data))
+  } else if (is.factor(label) || is.character(label)) {
+    stop("label must be numeric (0-based classes for classification); got ",
+         class(label)[1],
+         " - convert explicitly, e.g. as.integer(factor(y)) - 1")
+  }
+  out <- cbind(as.numeric(label), data)
+  utils::write.table(out, file = path, sep = "\t", na = "nan",
+                     row.names = FALSE, col.names = FALSE)
+  invisible(path)
+}
+
+# Reference side-file convention (src/io/metadata.cpp): one value per
+# line in <data>.weight / <data>.query / <data>.init next to the data.
+.lgbtpu_write_side <- function(path, ext, values) {
+  if (is.null(values)) return(invisible(NULL))
+  writeLines(format(values, scientific = FALSE, trim = TRUE),
+             paste0(path, ".", ext))
+  invisible(NULL)
+}
+
+# args owned by the binding itself; user params may not override them
+.lgbtpu_reserved <- c("task", "data", "output_model", "input_model",
+                      "output_result", "valid_data", "num_iterations")
+
+.lgbtpu_params <- function(params) {
+  if (length(params) == 0) return(character(0))
+  keys <- names(params)
+  if (is.null(keys) || any(!nzchar(keys))) {
+    stop("params must be a fully named list, e.g. ",
+         'list(objective = "binary", num_leaves = 31)')
+  }
+  bad <- intersect(keys, .lgbtpu_reserved)
+  if (length(bad)) {
+    stop("params may not override reserved arguments: ",
+         paste(bad, collapse = ", "),
+         " (use the function arguments / lgb.save instead)")
+  }
+  fmt <- function(v) {
+    if (is.logical(v)) v <- ifelse(v, "true", "false")
+    paste(v, collapse = ",")
+  }
+  vapply(keys, function(k) paste0(k, "=", fmt(params[[k]])), character(1))
+}
+
+# Parse the CLI's evaluation log lines
+#   "[LightGBM-TPU] [INFO] [12]\tvalid_1's auc: 0.83\tvalid_1's l2: ..."
+# into list(iter = int vector, sets = list(name -> metric -> numeric)).
+.lgbtpu_parse_eval_log <- function(log_lines) {
+  hits <- grep("\\[[0-9]+\\]\t", log_lines, value = TRUE)
+  iters <- integer(0)
+  sets <- list()
+  for (line in hits) {
+    m <- regmatches(line, regexec("\\[([0-9]+)\\]\t(.*)$", line))[[1]]
+    if (length(m) < 3) next
+    iters <- c(iters, as.integer(m[2]))
+    for (part in strsplit(m[3], "\t", fixed = TRUE)[[1]]) {
+      pm <- regmatches(part,
+                       regexec("^(.*)'s ([^:]+): ([-0-9.eE+naifNAIF]+)",
+                               part))[[1]]
+      if (length(pm) < 4) next
+      dname <- pm[2]; metric <- pm[3]; val <- as.numeric(pm[4])
+      if (is.null(sets[[dname]])) sets[[dname]] <- list()
+      if (is.null(sets[[dname]][[metric]])) sets[[dname]][[metric]] <- numeric(0)
+      sets[[dname]][[metric]] <- c(sets[[dname]][[metric]], val)
+    }
+  }
+  list(iter = iters, sets = sets)
+}
+
+# Split a model file's lines into per-tree blocks of key=value fields.
+# Numeric vector fields are space-separated (tree.py GBDT text format,
+# identical to the reference's gbdt_model_text.cpp).
+.lgbtpu_parse_trees <- function(model_string) {
+  starts <- grep("^Tree=", model_string)
+  trees <- list()
+  for (i in seq_along(starts)) {
+    from <- starts[i]
+    to <- if (i < length(starts)) starts[i + 1] - 1 else length(model_string)
+    block <- model_string[from:to]
+    block <- block[nzchar(block) & !startsWith(block, "feature importances")]
+    kv <- list()
+    for (line in block) {
+      eq <- regexpr("=", line, fixed = TRUE)
+      if (eq < 0) next
+      key <- substr(line, 1, eq - 1)
+      kv[[key]] <- substr(line, eq + 1, nchar(line))
+    }
+    trees[[i]] <- kv
+  }
+  trees
+}
+
+.lgbtpu_field_num <- function(tree_kv, key) {
+  raw <- tree_kv[[key]]
+  if (is.null(raw) || !nzchar(raw)) return(numeric(0))
+  as.numeric(strsplit(trimws(raw), "[[:space:]]+")[[1]])
+}
+
+.lgbtpu_feature_names <- function(model_string) {
+  line <- grep("^feature_names=", model_string, value = TRUE)
+  if (length(line) == 0) return(character(0))
+  strsplit(sub("^feature_names=", "", line[1]), " ", fixed = TRUE)[[1]]
+}
+
+.lgbtpu_num_class <- function(model_string) {
+  line <- grep("^num_class=", model_string, value = TRUE)
+  if (length(line) == 0) return(1L)
+  as.integer(sub("^num_class=", "", line[1]))
+}
+
+lgb.is.Dataset <- function(x) inherits(x, "lgb.Dataset")
+lgb.is.Booster <- function(x) inherits(x, "lgb.Booster")

@@ -193,6 +193,24 @@ def run_convert_model(cfg: Config, params: Dict[str, str]) -> None:
     log.info("Model converted to %s", cfg.convert_model)
 
 
+def run_dump_model(cfg: Config, params: Dict[str, str]) -> None:
+    """dump_model task: write the model as JSON (the C API's
+    LGBM_BoosterDumpModel / Python dump_model surface, exposed through
+    the CLI so file-transport bindings — the R package — can reach it).
+    Output path comes from ``convert_model`` (shared with the C++
+    converter task); when not given explicitly it defaults to
+    ``<input_model>.json`` rather than the converter's .cpp name."""
+    import json
+    if not cfg.input_model:
+        log.fatal("No model specified (input_model=...)")
+    out_path = (cfg.convert_model if cfg.convert_model != "gbdt_prediction.cpp"
+                else cfg.input_model + ".json")
+    booster = Booster(model_file=cfg.input_model, params=params)
+    with open(out_path, "w") as f:
+        json.dump(booster.dump_model(), f)
+    log.info("Model dumped to %s", out_path)
+
+
 def main(argv: List[str] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     params = parse_cli(argv)
@@ -210,6 +228,8 @@ def main(argv: List[str] = None) -> int:
         run_predict(cfg, params)
     elif task == "convert_model":
         run_convert_model(cfg, params)
+    elif task == "dump_model":
+        run_dump_model(cfg, params)
     else:
         log.fatal("Unknown task %s", task)
     return 0

@@ -1,0 +1,238 @@
+"""Tokenizer-level R lint — the mechanical parse check for R-package/.
+
+No R interpreter ships in this image, so this module implements the
+subset of R's lexical grammar needed to catch the errors that would
+make `R CMD check` fail to parse a file at all:
+
+* unterminated strings (quote / double-quote / backtick) and %op%s
+* unbalanced or mis-nested (), [], {}
+* a stray closer at top level
+
+and extracts the surface the tests assert on:
+
+* top-level `name <- function(arg1, arg2 = default, ...)` definitions
+  with their argument-name lists (R-package parity vs the reference's
+  signatures)
+
+Used by tests/test_r_package.py; run directly for a file report:
+    python scripts/r_lint.py R-package/R/*.R
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, NamedTuple, Optional, Tuple
+
+
+class Token(NamedTuple):
+    kind: str          # ident | string | num | punct | op
+    text: str
+    line: int
+
+
+class RLintError(Exception):
+    def __init__(self, path: str, line: int, message: str):
+        super().__init__(f"{path}:{line}: {message}")
+        self.path, self.line, self.message = path, line, message
+
+
+_PUNCT2 = ("<<-", "->>", "%%")
+_PUNCT = ("<-", "->", "<=", ">=", "==", "!=", "&&", "||", "::", "[[", "]]",
+          "=", "<", ">", "+", "-", "*", "/", "^", "!", "&", "|", "~", "?",
+          "(", ")", "[", "]", "{", "}", ",", ";", ":", "$", "@")
+
+
+def tokenize(src: str, path: str = "<string>") -> List[Token]:
+    toks: List[Token] = []
+    i, line, n = 0, 1, len(src)
+    while i < n:
+        c = src[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c in " \t\r\f":
+            i += 1
+            continue
+        if c == "#":
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        if c in "'\"`":
+            quote, start_line, start = c, line, i + 1
+            i += 1
+            while i < n:
+                if src[i] == "\\" and quote != "`":
+                    i += 2
+                    continue
+                if src[i] == quote:
+                    break
+                if src[i] == "\n":
+                    line += 1
+                i += 1
+            if i >= n:
+                raise RLintError(path, start_line,
+                                 f"unterminated {quote}-string")
+            # backticked names ARE identifiers (`dimnames<-.foo` <- ...);
+            # keep the content so function definitions resolve
+            toks.append(Token("string", src[start:i], start_line))
+            i += 1
+            continue
+        if c == "%":
+            # %op% infix operator (%%, %in%, %/%, %*%, ...): must close
+            # on the same line
+            j = src.find("%", i + 1)
+            eol = src.find("\n", i + 1)
+            if j < 0 or (0 <= eol < j):
+                raise RLintError(path, line, "unterminated %op%")
+            toks.append(Token("op", src[i:j + 1], line))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit()):
+            j = i
+            while j < n and (src[j].isalnum() or src[j] in ".+-xXeE"):
+                # crude but sufficient: numbers never contain brackets
+                if src[j] in "+-" and src[j - 1] not in "eE":
+                    break
+                j += 1
+            toks.append(Token("num", src[i:j], line))
+            i = j
+            continue
+        if c.isalpha() or c in "._":
+            j = i
+            while j < n and (src[j].isalnum() or src[j] in "._"):
+                j += 1
+            toks.append(Token("ident", src[i:j], line))
+            i = j
+            continue
+        matched = False
+        for p in _PUNCT2 + _PUNCT:
+            if src.startswith(p, i):
+                toks.append(Token("punct", p, line))
+                i += len(p)
+                matched = True
+                break
+        if not matched:
+            raise RLintError(path, line, f"unexpected character {c!r}")
+    return toks
+
+
+_OPENERS = {"(": ")", "[": "]", "{": "}", "[[": "]]"}
+_CLOSERS = {v: k for k, v in _OPENERS.items()}
+
+
+def check_balance(toks: List[Token], path: str) -> None:
+    # `[[`/`]]` count as two single `[`/`]`s: R's parser pairs the halves
+    # freely across the token boundary (`x[[y[1]]]` closes `[` then `[[`),
+    # so only the per-bracket-kind pairing is checkable lexically.
+    stack: List[Token] = []
+    for t in toks:
+        if t.kind != "punct":
+            continue
+        if t.text in _OPENERS:
+            reps = 2 if t.text == "[[" else 1
+            stack.extend([Token("punct", "[" if reps == 2 else t.text,
+                                t.line)] * reps)
+        elif t.text in _CLOSERS:
+            need = "[" if t.text in ("]", "]]") else _CLOSERS[t.text]
+            for _ in range(2 if t.text == "]]" else 1):
+                if not stack:
+                    raise RLintError(path, t.line,
+                                     f"unmatched closer {t.text!r}")
+                top = stack.pop()
+                if top.text != need:
+                    raise RLintError(
+                        path, t.line,
+                        f"mismatched {t.text!r} closing {top.text!r} "
+                        f"opened at line {top.line}")
+    if stack:
+        t = stack[-1]
+        raise RLintError(path, t.line, f"unclosed {t.text!r}")
+
+
+class RFunction(NamedTuple):
+    name: str
+    args: Tuple[str, ...]
+    line: int
+
+
+def _collect_args(toks: List[Token], open_idx: int,
+                  path: str) -> Tuple[Tuple[str, ...], int]:
+    """Argument NAMES of a function(...) whose '(' is at open_idx;
+    returns (names, index just past the matching ')')."""
+    depth = 0
+    names: List[str] = []
+    expect_name = True
+    i = open_idx
+    while i < len(toks):
+        t = toks[i]
+        if t.kind == "punct" and t.text in _OPENERS:
+            depth += 2 if t.text == "[[" else 1
+        elif t.kind == "punct" and t.text in _CLOSERS:
+            depth -= 2 if t.text == "]]" else 1
+            if depth == 0:
+                return tuple(names), i + 1
+        elif depth == 1:
+            if t.kind == "punct" and t.text == ",":
+                expect_name = True
+            elif expect_name and t.kind in ("ident", "string"):
+                names.append(t.text)
+                expect_name = False
+            elif expect_name and t.kind == "punct" and t.text == "...":
+                names.append("...")
+                expect_name = False
+        i += 1
+    raise RLintError(path, toks[open_idx].line, "unclosed argument list")
+
+
+def top_level_functions(toks: List[Token], path: str) -> List[RFunction]:
+    out: List[RFunction] = []
+    depth = 0
+    i = 0
+    while i < len(toks):
+        t = toks[i]
+        if t.kind == "punct" and t.text in _OPENERS:
+            depth += 2 if t.text == "[[" else 1
+        elif t.kind == "punct" and t.text in _CLOSERS:
+            depth -= 2 if t.text == "]]" else 1
+        elif (depth == 0 and t.kind in ("ident", "string")
+              and i + 2 < len(toks)
+              and toks[i + 1].kind == "punct"
+              and toks[i + 1].text in ("<-", "=", "<<-")
+              and toks[i + 2].kind == "ident"
+              and toks[i + 2].text == "function"
+              and i + 3 < len(toks) and toks[i + 3].text == "("):
+            args, nxt = _collect_args(toks, i + 3, path)
+            out.append(RFunction(t.text, args, t.line))
+            i = nxt
+            continue
+        i += 1
+    return out
+
+
+def lint_file(path: str) -> List[RFunction]:
+    """Raise RLintError on lexical/balance problems; return the
+    top-level function definitions."""
+    with open(path) as f:
+        src = f.read()
+    toks = tokenize(src, path)
+    check_balance(toks, path)
+    return top_level_functions(toks, path)
+
+
+def main(argv: List[str]) -> int:
+    status = 0
+    for path in argv:
+        try:
+            fns = lint_file(path)
+        except RLintError as e:
+            print(f"FAIL {e}")
+            status = 1
+            continue
+        print(f"OK   {path}: {len(fns)} top-level functions")
+        for fn in fns:
+            print(f"       {fn.name}({', '.join(fn.args)})")
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -26,6 +26,30 @@ import subprocess
 import numpy as np
 import pytest
 
+# Fast-tier discipline: the full suite takes ~18 min (native builds, the
+# reference-CLI oracle, 8-device mesh trainings, multi-process sockets),
+# which is too slow a loop for perf iteration.  Modules dominated by those
+# costs are auto-marked `slow`; `pytest -m "not slow"` is the ~2-minute
+# fast loop covering the pure-Python/JAX core.
+SLOW_MODULES = {
+    "test_parallel", "test_interop", "test_multiprocess", "test_streaming",
+    "test_capi_train", "test_native", "test_convert_model", "test_tpu",
+}
+# individually measured >20s (full multi-model trainings); everything
+# else in their modules stays in the fast tier
+SLOW_TESTS = {
+    "test_grid_search", "test_cv_and_cvbooster",
+    "test_cv_lambdarank_group_folds",
+    "test_bundled_training_matches_unbundled_exactly",
+}
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if (item.module.__name__ in SLOW_MODULES
+                or item.name in SLOW_TESTS):
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="session")
 def ref_bin():
