@@ -131,6 +131,11 @@ def child_main():
         "use_pallas": use_pallas and platform == "tpu",
         "enable_bundle": sparsity > 0.0,
     }
+    # ad-hoc A/B knobs (e.g. BENCH_EXTRA_PARAMS=enable_bin_packing=false)
+    for kv in filter(None, os.environ.get("BENCH_EXTRA_PARAMS",
+                                          "").split(",")):
+        k, _, v = kv.partition("=")
+        params[k] = v
     cfg = config_from_params(params)
     t0 = time.perf_counter()
     ds = construct(X, cfg, label=y)

@@ -143,7 +143,7 @@ class GBDT:
         self._feat_valid_base = np.ones(len(fm["is_categorical"]), dtype=bool)
         self._bag_weight = jnp.ones((n,), jnp.float32)
         self._bag_cnt = jnp.ones((n,), jnp.float32)
-        self._subset_state = None     # (bins[M,F], idx[M], w[M], cnt[M])
+        self._subset_state = None  # (bins[M,F], idx[M], w[M], cnt[M], hist)
         self._bag_rng = make_rng(cfg.bagging_seed)
         self._feat_rng = make_rng(cfg.feature_fraction_seed)
 
@@ -170,6 +170,8 @@ class GBDT:
         self._feat_pad = 0
         self._multiproc = False
         self._local_bins_cache = None
+        self._pack_plan = None
+        self._hist_bins = None
         n_devices = len(jax.devices())
         use_dist = cfg.tree_learner != "serial" and (
             cfg.mesh_devices != 1 and n_devices > 1)
@@ -179,6 +181,28 @@ class GBDT:
                       "(per-process row partitions) or feature (full data "
                       "on every process) over >1 devices; a serial learner "
                       "would silently train per-partition models")
+        # nibble-pack <=16-bin column pairs for the histogram path
+        # (dense_nbits_bin.hpp analogue, data/packing.py).  Multi-process
+        # global arrays and the feature-parallel column slicing keep the
+        # 1:1 layout (a packed byte would straddle shard ownership).
+        if (cfg.enable_bin_packing and process_count() == 1
+                and not (use_dist and cfg.tree_learner
+                         in ("feature", "data_feature"))):
+            from .data.packing import build_pack_plan, pack_columns
+            col_bins = (train.layout.col_num_bin
+                        if train.layout is not None
+                        and train.layout.has_bundles
+                        else [train.bin_mappers[i].num_bin
+                              for i in train.used_features])
+            self._pack_plan = build_pack_plan(col_bins)
+            if self._pack_plan is not None:
+                self._hist_bins = pack_columns(np.asarray(train.binned),
+                                               self._pack_plan)
+                log.info("Bin packing: %d of %d columns nibble-packed "
+                         "into %d bytes/row (histogram path)",
+                         self._pack_plan.num_packed,
+                         self._pack_plan.num_phys_cols,
+                         self._pack_plan.num_storage_cols)
         # the bagged-subset optimization (gbdt.cpp:323-382 is_use_subset_)
         # gathers rows into a compact matrix — serial learner only for now
         self._can_subset = not use_dist
@@ -189,12 +213,27 @@ class GBDT:
                             "back to serial", cfg.tree_learner, n_devices,
                             cfg.mesh_devices)
             self.bins = jnp.asarray(self.bins)
-            self.grow = jax.jit(make_grower(self.grower_cfg))
+            if self._hist_bins is not None:
+                self._hist_bins = jnp.asarray(self._hist_bins)
+            self.grow = jax.jit(make_grower(self.grower_cfg,
+                                            pack_plan=self._pack_plan))
             return
         from .parallel.learner import make_distributed_grower
-        from .parallel.mesh import make_mesh, pad_features, pad_rows
+        from .parallel.mesh import (make_2d_mesh, make_mesh, pad_features,
+                                    pad_rows)
         axis = "feature" if cfg.tree_learner == "feature" else "data"
-        mesh = make_mesh(cfg.mesh_devices or 0, axis)
+        if cfg.tree_learner == "data_feature":
+            # near-square factorization of the device count into
+            # data x feature shards (the 2-D hybrid learner); clamp to
+            # the available devices like make_mesh's 1-D truncation
+            nd = min(cfg.mesh_devices or n_devices, n_devices)
+            dr = max(d for d in range(1, int(nd ** 0.5) + 1) if nd % d == 0)
+            mesh = make_2d_mesh(dr, nd // dr)
+            if jax.process_count() > 1:
+                log.fatal("tree_learner=data_feature is single-process for "
+                          "now; use data/voting/feature across machines")
+        else:
+            mesh = make_mesh(cfg.mesh_devices or 0, axis)
         shards = int(mesh.devices.size)
         n = self.num_data
         self._multiproc = jax.process_count() > 1
@@ -226,14 +265,23 @@ class GBDT:
             log.info("Multi-process training: %d processes, %d local rows, "
                      "%d global (padded) rows", jax.process_count(), n,
                      self._global_rows)
-        elif cfg.tree_learner in ("data", "voting"):
-            self._row_pad = pad_rows(n, shards)
+        elif cfg.tree_learner in ("data", "voting", "data_feature"):
+            row_shards = (int(mesh.shape["data"])
+                          if cfg.tree_learner == "data_feature" else shards)
+            self._row_pad = pad_rows(n, row_shards)
             self.bins = (jnp.pad(self.bins, ((0, self._row_pad), (0, 0)))
                          if self._row_pad else jnp.asarray(self.bins))
-        if cfg.tree_learner == "feature":
+            if self._hist_bins is not None:
+                hb = self._hist_bins
+                self._hist_bins = (
+                    jnp.pad(hb, ((0, self._row_pad), (0, 0)))
+                    if self._row_pad else jnp.asarray(hb))
+        if cfg.tree_learner in ("feature", "data_feature"):
             bundled = self.meta.col is not None
             ncols = int(np.shape(self.bins)[1])
-            col_pad = pad_features(ncols, shards)
+            col_shards = (int(mesh.shape["feature"])
+                          if cfg.tree_learner == "data_feature" else shards)
+            col_pad = pad_features(ncols, col_shards)
             # pad PHYSICAL columns; bundled logical meta stays intact
             # (no logical feature maps to a pad column)
             binned = np.asarray(self.bins)
@@ -288,7 +336,8 @@ class GBDT:
                  cfg.tree_learner, shards)
         self.grow = make_distributed_grower(self.grower_cfg, mesh,
                                             cfg.tree_learner, cfg.top_k,
-                                            bundled=self.meta.col is not None)
+                                            bundled=self.meta.col is not None,
+                                            pack_plan=self._pack_plan)
 
     def _make_metrics(self, data: TrainingData) -> List[Metric]:
         out = []
@@ -383,7 +432,9 @@ class GBDT:
         self._subset_state = (jnp.take(self.bins, idx_d, axis=0),
                               idx_d,
                               jnp.asarray(w_p),
-                              jnp.asarray((w_p > 0).astype(np.float32)))
+                              jnp.asarray((w_p > 0).astype(np.float32)),
+                              (jnp.take(self._hist_bins, idx_d, axis=0)
+                               if self._hist_bins is not None else None))
         self._bag_weight = jnp.ones((self.num_data,), jnp.float32)
         self._bag_cnt = self._bag_weight
 
@@ -440,13 +491,17 @@ class GBDT:
             with self.timers.phase("tree"):
                 if self._subset_state is not None:
                     # compact bagged matrix: tree cost is O(bagged rows)
-                    sbins, sidx, sw, scnt = self._subset_state
-                    arrays, row_leaf = self.grow(sbins, g[k][sidx] * sw,
+                    sbins, sidx, sw, scnt, shist = self._subset_state
+                    hist_arg = (shist,) if self._pack_plan is not None else ()
+                    arrays, row_leaf = self.grow(sbins, *hist_arg,
+                                                 g[k][sidx] * sw,
                                                  h[k][sidx] * sw, scnt,
                                                  self.meta, feat_mask)
                 else:
+                    hist_arg = ((self._hist_bins,)
+                                if self._pack_plan is not None else ())
                     arrays, row_leaf = self.grow(
-                        self.bins,
+                        self.bins, *hist_arg,
                         self._dist_row_vec(g[k] * self._bag_weight),
                         self._dist_row_vec(h[k] * self._bag_weight),
                         self._dist_row_vec(cnt), self.meta, feat_mask)

@@ -29,6 +29,7 @@ PARAM_ALIASES: Dict[str, str] = {
     "model_out": "output_model",
     "model_input": "input_model",
     "model_in": "input_model",
+    "bin_packing": "enable_bin_packing",
     "predict_result": "output_result",
     "prediction_result": "output_result",
     "valid": "valid_data",
@@ -114,7 +115,7 @@ class Config:
     num_iterations: int = 100
     learning_rate: float = 0.1
     num_class: int = 1
-    tree_learner: str = "serial"   # serial | feature | data | voting
+    tree_learner: str = "serial"  # serial|feature|data|voting|data_feature
 
     # tree
     num_leaves: int = 31
@@ -149,6 +150,7 @@ class Config:
     use_missing: bool = True
     zero_as_missing: bool = False
     enable_bundle: bool = True
+    enable_bin_packing: bool = True  # nibble-pack <=16-bin column pairs
     is_enable_sparse: bool = True
     sparse_threshold: float = 0.8
     max_conflict_rate: float = 0.0
@@ -321,7 +323,8 @@ def check_param_conflicts(cfg: Config) -> None:
         log.fatal("Number of classes should be specified and greater than 1 for multiclass training")
     if not is_multiclass and cfg.num_class != 1:
         log.fatal("Number of classes must be 1 for non-multiclass training")
-    if cfg.tree_learner not in ("serial", "feature", "data", "voting"):
+    if cfg.tree_learner not in ("serial", "feature", "data", "voting",
+                                "data_feature"):
         log.fatal("Unknown tree learner type %s", cfg.tree_learner)
     if cfg.boosting_type not in ("gbdt", "gbrt", "dart", "goss", "rf", "random_forest"):
         log.fatal("Unknown boosting type %s", cfg.boosting_type)

@@ -1,0 +1,122 @@
+"""Small-bin column packing — the TPU answer to dense 4-bit bins.
+
+The reference stores features with <= 16 bins nibble-packed
+(``src/io/dense_nbits_bin.hpp:12-405``) and its GPU learner packs 8
+features per int32 (``gpu_tree_learner.cpp:234-556``) because histogram
+building is bandwidth-bound.  Here the same observation holds — the
+per-leaf row gather of the binned matrix is the HBM roofline
+(docs/PERF.md) — but the packing is designed around the MXU histogram
+kernel instead of translated:
+
+two physical columns a (lo) and b (hi), both with <= 16 bins, share one
+byte ``v = a | (b << 4)``.  The byte value IS the joint (a, b) bin index
+over a 16 x 16 grid, so the EXISTING 256-wide one-hot histogram kernels
+(pallas / einsum / segment) run on packed columns UNCHANGED; the two
+16-bin feature histograms fall out of the joint [256]-bin histogram by
+summing over each nibble axis (``unfold_packed_hist``).  Per packed
+pair this HALVES the gather bytes AND the histogram compute relative to
+two unpacked uint8 columns at a 256-wide one-hot.
+
+The packed matrix is a SECOND device copy used only by the histogram
+path; routing/partition and leaf traversal keep the unpacked matrix
+(they read single columns — decode would buy nothing).  Packed-pair
+datasets are narrow by construction (<= 16-bin columns), so the extra
+copy is small exactly when it exists.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+PACK_MAX_BIN = 16          # nibble capacity
+PACK_JOINT_BINS = 256      # joint (lo, hi) index space
+
+
+class PackPlan(NamedTuple):
+    """Static (host) description of the packed layout.
+
+    Maps each PHYSICAL column f of the logical binned matrix to its
+    storage: ``byte_col[f]`` is its column in the packed matrix,
+    ``shift[f]`` is 0 (lo nibble / unpacked) or 4 (hi nibble), and
+    ``is_packed[f]`` says whether f shares its byte with a partner.
+    """
+    byte_col: np.ndarray       # [Fp] i32
+    shift: np.ndarray          # [Fp] i32, 0 or 4
+    is_packed: np.ndarray      # [Fp] bool
+    num_storage_cols: int
+    num_phys_cols: int
+
+    @property
+    def num_packed(self) -> int:
+        return int(self.is_packed.sum())
+
+
+def build_pack_plan(col_num_bins) -> Optional[PackPlan]:
+    """Pairing plan over physical columns: columns with <= 16 bins are
+    packed two-per-byte (an odd leftover keeps a byte to itself in the
+    lo nibble); wider columns pass through.  Returns None when fewer
+    than 2 columns are packable (no traffic to save)."""
+    nb = np.asarray(col_num_bins, dtype=np.int64)
+    fp = len(nb)
+    narrow = np.flatnonzero(nb <= PACK_MAX_BIN)
+    if len(narrow) < 2:
+        return None
+    wide = np.flatnonzero(nb > PACK_MAX_BIN)
+    byte_col = np.zeros(fp, dtype=np.int32)
+    shift = np.zeros(fp, dtype=np.int32)
+    is_packed = np.zeros(fp, dtype=bool)
+    c = 0
+    for f in wide:
+        byte_col[f] = c
+        c += 1
+    for i in range(0, len(narrow) - 1, 2):
+        a, b = narrow[i], narrow[i + 1]
+        byte_col[a] = byte_col[b] = c
+        shift[b] = 4
+        is_packed[a] = is_packed[b] = True
+        c += 1
+    if len(narrow) % 2:
+        f = narrow[-1]
+        byte_col[f] = c
+        c += 1
+    return PackPlan(byte_col, shift, is_packed, c, fp)
+
+
+def pack_columns(binned: np.ndarray, plan: PackPlan) -> np.ndarray:
+    """[N, Fp] binned matrix -> [N, C] packed storage matrix (same
+    dtype; nibble pairs merged, other columns copied)."""
+    n = binned.shape[0]
+    out = np.zeros((n, plan.num_storage_cols), dtype=binned.dtype)
+    for f in range(plan.num_phys_cols):
+        shifted = (binned[:, f].astype(np.int32)
+                   << int(plan.shift[f])).astype(binned.dtype)
+        np.bitwise_or(out[:, plan.byte_col[f]], shifted,
+                      out=out[:, plan.byte_col[f]])
+    return out
+
+
+def unfold_packed_hist(hist_c, plan: PackPlan, out_bins: int):
+    """Joint storage-column histograms -> physical-column histograms.
+
+    hist_c [C, B_joint >= 256, S] -> [Fp, out_bins, S]: a packed
+    column's joint histogram reshaped to [16, 16] grids sums over the
+    partner's axis to give each nibble feature's 16-bin histogram (the
+    FixHistogram-style reconstruction, but exact — no parent needed);
+    unpacked columns pass through."""
+    import jax.numpy as jnp
+    c, bj, s = hist_c.shape
+    h4 = hist_c[:, :PACK_JOINT_BINS].reshape(c, PACK_MAX_BIN, PACK_MAX_BIN, s)
+    lo_h = h4.sum(axis=1)                      # [C, 16, S] lo-nibble feature
+    hi_h = h4.sum(axis=2)                      # [C, 16, S] hi-nibble feature
+    byte_col = jnp.asarray(plan.byte_col)
+    nib = jnp.where((jnp.asarray(plan.shift) == 0)[:, None, None],
+                    lo_h[byte_col], hi_h[byte_col])        # [Fp, 16, S]
+    if out_bins > PACK_MAX_BIN:
+        nib = jnp.pad(nib, ((0, 0), (0, out_bins - PACK_MAX_BIN), (0, 0)))
+    else:
+        nib = nib[:, :out_bins]
+    wide = hist_c[byte_col, :out_bins]
+    if out_bins > bj:
+        wide = jnp.pad(wide, ((0, 0), (0, out_bins - bj), (0, 0)))
+    return jnp.where(jnp.asarray(plan.is_packed)[:, None, None], nib, wide)

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .data.packing import PACK_JOINT_BINS, unfold_packed_hist
 from .ops.histogram import subset_histogram
 from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
                         best_split, leaf_output)
@@ -270,19 +271,31 @@ def _bucket_index(scnt, kmin: int, kmax: int):
     return jnp.sum((scnt > table).astype(jnp.int32))
 
 
-def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
+def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
     """Build the jittable ``grow_tree`` function.
 
     ``strategy`` selects the (distributed) learner; default is the
     single-device :class:`SerialStrategy`.  This mirrors the reference's
     ``CreateTreeLearner`` factory (tree_learner.cpp:9-33) with strategies in
     place of subclass overrides.
+
+    ``pack_plan`` (data/packing.py) switches the histogram path to a
+    nibble-packed storage matrix, the dense_nbits_bin.hpp analogue: the
+    returned function then takes an EXTRA second argument ``hist_bins``
+    — the packed [N, C] matrix — while routing keeps reading the
+    unpacked ``bins``.  Joint 256-bin histograms over the storage
+    columns are unfolded to physical columns right after measurement,
+    so everything downstream (hist store, parent subtraction, bundle
+    expansion, split scan) is layout-agnostic.
     """
     L = cfg.num_leaves
     if strategy is None:
         strategy = SerialStrategy(cfg)
+    hist_width = (max(PACK_JOINT_BINS, cfg.max_bin) if pack_plan is not None
+                  else cfg.max_bin)
 
-    def grow_tree(bins: jnp.ndarray,        # [N, F] uint8/uint16/int32
+    def grow_impl(bins: jnp.ndarray,        # [N, F] uint8/uint16/int32
+                  hist_src: jnp.ndarray,    # [N, C] histogram storage matrix
                   gw: jnp.ndarray,          # [N] f32   grad * bag_weight
                   hw: jnp.ndarray,          # [N] f32   hess * bag_weight
                   cw: jnp.ndarray,          # [N] f32   bag weight (0/1 or frac)
@@ -291,9 +304,10 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
                   ):
         n, f = bins.shape
         dtype = gw.dtype
-        ctx = strategy.setup(bins, meta, feat_valid)
-        hbins = strategy.hist_bins(ctx, bins)        # [N, F_hist]
-        fh = hbins.shape[1]
+        ctx = strategy.setup(hist_src, meta, feat_valid)
+        hbins = strategy.hist_bins(ctx, hist_src)    # [N, F_hist]
+        fh = (pack_plan.num_phys_cols if pack_plan is not None
+              else hbins.shape[1])
 
         # pow2 gather buckets for the smaller child (static branch sizes)
         kmin = cfg.bucket_min_log2
@@ -302,7 +316,7 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
 
         # sentinel row n: weight 0, bin 0 — receives all buffer padding
         hbins_pad = jnp.concatenate(
-            [hbins, jnp.zeros((1, fh), hbins.dtype)], axis=0)
+            [hbins, jnp.zeros((1, hbins.shape[1]), hbins.dtype)], axis=0)
         gw_pad = jnp.concatenate([gw, jnp.zeros((1,), dtype)])
         hw_pad = jnp.concatenate([hw, jnp.zeros((1,), dtype)])
         cw_pad = jnp.concatenate([cw, jnp.zeros((1,), dtype)])
@@ -311,13 +325,24 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             return strategy.find(ctx, hist, pg, ph, pc, feat_ok)
 
         def measure(idx):
-            """Histogram of rows ``idx`` (sentinel-padded) -> [F_hist, B, 3]."""
+            """RAW histogram of rows ``idx`` (sentinel-padded): packed
+            storage columns stay in joint form so a cross-shard psum
+            moves one 256-bin histogram per packed PAIR; ``globalize``
+            unfolds after the reduction (unfolding is linear, so the
+            order is correctness-neutral and bandwidth-positive)."""
             rows = jnp.take(hbins_pad, idx, axis=0)
             return subset_histogram(rows, gw_pad[idx], hw_pad[idx],
-                                    cw_pad[idx], cfg.max_bin,
+                                    cw_pad[idx], hist_width,
                                     method=cfg.hist_method,
                                     feat_tile=cfg.feat_tile,
                                     row_tile=cfg.row_tile)
+
+        def globalize(hist):
+            """reduce across shards, then unfold packed columns."""
+            hist = strategy.reduce_hist(hist)
+            if pack_plan is not None:
+                hist = unfold_packed_hist(hist, pack_plan, cfg.max_bin)
+            return hist
 
         def bucket_branch(k):
             def branch(args):
@@ -396,8 +421,8 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
 
         num_logical = meta.num_bin.shape[0]
         feat_ok_all = jnp.ones((num_logical,), bool)
-        hist_root = strategy.reduce_hist(
-            subset_histogram(hbins, gw, hw, cw, cfg.max_bin,
+        hist_root = globalize(
+            subset_histogram(hbins, gw, hw, cw, hist_width,
                              method=cfg.hist_method,
                              feat_tile=cfg.feat_tile,
                              row_tile=cfg.row_tile))
@@ -511,7 +536,7 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
             scnt = jnp.where(small_left, nl, nr)   # LOCAL count of that child
             ki = _bucket_index(scnt, kmin, kmax)
             hist_small = lax.switch(ki, branches, (order, sstart, scnt))
-            hist_small = strategy.reduce_hist(hist_small)
+            hist_small = globalize(hist_small)
             hist_parent = lax.dynamic_index_in_dim(state.hist_store, l, axis=0,
                                                    keepdims=False)
             hist_large = hist_parent - hist_small
@@ -550,4 +575,13 @@ def make_grower(cfg: GrowerConfig, strategy=None) -> Callable:
         state = lax.while_loop(cond, body, state)
         return state.tree, state.row_leaf[:n]
 
-    return grow_tree
+    if pack_plan is None:
+        # keep the historical 6-arg signature: histogram from the same
+        # matrix routing reads
+        def grow_tree(bins, gw, hw, cw, meta, feat_valid):
+            return grow_impl(bins, bins, gw, hw, cw, meta, feat_valid)
+        return grow_tree
+
+    def grow_tree_packed(bins, hist_bins, gw, hw, cw, meta, feat_valid):
+        return grow_impl(bins, hist_bins, gw, hw, cw, meta, feat_valid)
+    return grow_tree_packed

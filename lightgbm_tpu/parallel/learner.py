@@ -161,6 +161,31 @@ class FeatureParallelStrategy(SerialStrategy):
         return _broadcast_from_winner(res, self.axis), ok_global
 
 
+class DataFeatureStrategy(FeatureParallelStrategy):
+    """2-D hybrid: rows sharded over the ``data`` mesh axis, the split
+    scan sharded over the ``feature`` axis.
+
+    The composition the reference leaves to its template parameter
+    (``data_parallel_tree_learner.cpp:255-256`` instantiates
+    DataParallel<GPUTreeLearner> etc. but never ships a data x feature
+    product): each (d, f) device histograms ITS row shard over ITS
+    column slice; a psum over ``data`` makes the slice's histograms
+    global, and the feature-axis argmax sync of the parent class agrees
+    on the winning split.  Row routing happens on the data shard,
+    replicated across the feature axis."""
+
+    def __init__(self, cfg: GrowerConfig, data_axis: str = "data",
+                 feat_axis: str = "feature", num_feat_shards: int = 1):
+        super().__init__(cfg, feat_axis, num_feat_shards)
+        self.data_axis = data_axis
+
+    def reduce_hist(self, hist):
+        return lax.psum(hist, self.data_axis)
+
+    def reduce_scalar(self, x):
+        return lax.psum(x, self.data_axis)
+
+
 class VotingStrategy(SerialStrategy):
     """Data-parallel with top-k vote compression (PV-tree).
 
@@ -253,14 +278,18 @@ class VotingStrategy(SerialStrategy):
 
 def make_distributed_grower(cfg: GrowerConfig, mesh: Mesh,
                             tree_learner: str = "data",
-                            top_k: int = 20, bundled: bool = False):
+                            top_k: int = 20, bundled: bool = False,
+                            pack_plan=None):
     """shard_map-wrapped grow function for a 1-D mesh.
 
     Returns ``fn(bins, gw, hw, cw, meta, feat_valid) -> (TreeArrays, row_leaf)``
     operating on global (host-level) arrays.  Rows (data/voting) or the
     feature scan (feature) are sharded over the mesh axis.  ``bundled``
     states whether the FeatureMeta carries EFB col/offset arrays (their
-    specs must match the pytree).
+    specs must match the pytree).  ``pack_plan`` (data/packing.py) adds a
+    second positional arg — the nibble-packed histogram matrix, sharded
+    like ``bins`` (data/voting only; the feature learner's column
+    slicing is incompatible with shared bytes and boosting gates it off).
     """
     axis = mesh.axis_names[0]
     n_shards = mesh.devices.size
@@ -276,17 +305,34 @@ def make_distributed_grower(cfg: GrowerConfig, mesh: Mesh,
         strategy = FeatureParallelStrategy(cfg, axis, n_shards)
         in_row = P()
         row_out = P()
+    elif tree_learner == "data_feature":
+        if len(mesh.axis_names) != 2:
+            raise ValueError("data_feature needs a 2-D (data x feature) mesh")
+        da, fa = mesh.axis_names
+        strategy = DataFeatureStrategy(cfg, da, fa,
+                                       int(mesh.shape[fa]))
+        in_row = P(da)
+        row_out = P(da)
     else:
         raise ValueError(f"unknown tree_learner {tree_learner}")
 
-    grow = make_grower(cfg, strategy)
-    bins_spec = P(axis, None) if tree_learner in ("data", "voting") else P()
+    if pack_plan is not None and tree_learner in ("feature", "data_feature"):
+        raise ValueError("bin packing is incompatible with the "
+                         "feature-parallel column slicing")
+    grow = make_grower(cfg, strategy, pack_plan=pack_plan)
+    if tree_learner in ("data", "voting"):
+        bins_spec = P(axis, None)
+    elif tree_learner == "data_feature":
+        bins_spec = P(mesh.axis_names[0], None)   # rows sharded, cols whole
+    else:
+        bins_spec = P()
     meta_spec = (FeatureMeta(P(), P(), P(), P(), P(), P()) if bundled
                  else FeatureMeta(P(), P(), P(), P()))
     tree_spec = TreeArrays(*([P()] * len(TreeArrays._fields)))
+    hist_spec = (bins_spec,) if pack_plan is not None else ()
 
     fn = shard_map(grow, mesh=mesh,
-                   in_specs=(bins_spec, in_row, in_row, in_row,
+                   in_specs=(bins_spec, *hist_spec, in_row, in_row, in_row,
                              meta_spec, P()),
                    out_specs=(tree_spec, row_out),
                    check_vma=False)

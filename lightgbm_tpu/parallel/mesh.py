@@ -31,8 +31,9 @@ def make_mesh(num_devices: int = 0, axis_name: str = DATA_AXIS,
 
 
 def make_2d_mesh(data: int, feature: int) -> Mesh:
-    """data x feature mesh for combined row/column sharding (reserved for
-    the 2-D hybrid learner; not yet wired into the boosting layer)."""
+    """data x feature mesh for combined row/column sharding — the 2-D
+    hybrid learner (``tree_learner=data_feature``,
+    parallel/learner.py DataFeatureStrategy)."""
     devs = np.asarray(jax.devices()[:data * feature]).reshape(data, feature)
     return Mesh(devs, (DATA_AXIS, FEATURE_AXIS))
 

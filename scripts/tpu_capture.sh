@@ -31,11 +31,19 @@ BENCH_ROWS=200000 BENCH_FEATURES=2000 BENCH_TREES=5 \
     > "$OUT/bench_wide.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_wide.json" | tee -a "$OUT/log.txt"
 
-echo "== bench sparse (EFB) ==" | tee -a "$OUT/log.txt"
+echo "== bench sparse (EFB + nibble packing) ==" | tee -a "$OUT/log.txt"
 BENCH_SPARSITY=0.9 BENCH_FEATURES=100 BENCH_TREES=5 \
     BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
     > "$OUT/bench_sparse.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_sparse.json" | tee -a "$OUT/log.txt"
+
+echo "== bench sparse A/B: packing OFF (docs/MEMORY.md decision) ==" \
+    | tee -a "$OUT/log.txt"
+BENCH_SPARSITY=0.9 BENCH_FEATURES=100 BENCH_TREES=5 \
+    BENCH_EXTRA_PARAMS=enable_bin_packing=false \
+    BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
+    > "$OUT/bench_sparse_nopack.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_sparse_nopack.json" | tee -a "$OUT/log.txt"
 
 echo "== profile sweep ==" | tee -a "$OUT/log.txt"
 timeout 1800 python scripts/tpu_profile.py 1000000 \

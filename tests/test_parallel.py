@@ -66,6 +66,35 @@ def test_voting_parallel_quality(data):
     assert auc_vote == pytest.approx(auc_serial, abs=5e-3)
 
 
+def test_data_feature_2d_matches_serial(data):
+    """The 2-D hybrid learner (rows x feature-scan over a 2x4 mesh,
+    DataFeatureStrategy) must reproduce the serial tree exactly: the
+    data-axis psum makes each column slice's histograms global and the
+    feature-axis argmax sync picks the identical split."""
+    X, y, Xt, yt = data
+    auc_serial, bst_s = _train_auc(X, y, Xt, yt, {"tree_learner": "serial"})
+    auc_2d, bst_2 = _train_auc(X, y, Xt, yt,
+                               {"tree_learner": "data_feature"})
+    assert auc_2d == pytest.approx(auc_serial, abs=5e-3)
+    t_s, t_2 = bst_s.inner.models[0], bst_2.inner.models[0]
+    np.testing.assert_array_equal(t_s.split_feature, t_2.split_feature)
+    np.testing.assert_array_equal(t_s.threshold_bin, t_2.threshold_bin)
+
+
+def test_data_feature_2d_with_bundles():
+    """EFB bundles through the 2-D learner: the column-window expand maps
+    must compose with the data-axis histogram psum."""
+    X, y, Xt, yt = _bundled_problem()
+    auc_serial, bst_s = _train_auc(X, y, Xt, yt, {"tree_learner": "serial"})
+    auc_2d, bst_2 = _train_auc(X, y, Xt, yt,
+                               {"tree_learner": "data_feature"})
+    assert bst_2.inner.train_set.layout is not None, "expected EFB bundles"
+    assert auc_2d == pytest.approx(auc_serial, abs=5e-3)
+    t_s, t_2 = bst_s.inner.models[0], bst_2.inner.models[0]
+    np.testing.assert_array_equal(t_s.split_feature, t_2.split_feature)
+    np.testing.assert_array_equal(t_s.threshold_bin, t_2.threshold_bin)
+
+
 def test_voting_local_constraint_scaling(data):
     """The LOCAL vote scan must divide min_data_in_leaf /
     min_sum_hessian_in_leaf by the shard count
