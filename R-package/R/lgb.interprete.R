@@ -1,0 +1,108 @@
+# lgb.interprete: per-prediction feature contributions
+# (R-package/R/lgb.interprete.R surface in base R).
+#
+# The contribution of a split node to one prediction is the change in
+# model value along the taken branch (child value - node value); the
+# leaf path comes from `predict(..., predleaf = TRUE)` through the CLI
+# and the node values from lgb.model.dt.tree, so no R-side tree
+# routing is needed — the same decomposition the reference computes.
+
+lgb.interprete <- function(model,
+                           data,
+                           idxset,
+                           num_iteration = NULL) {
+  tree_dt <- lgb.model.dt.tree(model, num_iteration)
+  num_class <- .lgbtpu_num_class(model$model_string)
+  leafs <- predict(model, as.matrix(data)[idxset, , drop = FALSE],
+                   num_iteration = num_iteration, predleaf = TRUE)
+  leafs <- matrix(leafs, nrow = length(idxset))
+  lapply(seq_along(idxset), function(i) {
+    single.row.interprete(
+      tree_dt, num_class,
+      matrix(seq_len(ncol(leafs)) - 1L, ncol = num_class, byrow = TRUE),
+      matrix(leafs[i, ], ncol = num_class, byrow = TRUE))
+  })
+}
+
+single.tree.interprete <- function(tree_dt, tree_id, leaf_id) {
+  st <- tree_dt[tree_dt$tree_index == tree_id, , drop = FALSE]
+  leaves <- st[!is.na(st$leaf_index), , drop = FALSE]
+  nodes <- st[!is.na(st$split_index), , drop = FALSE]
+  li <- match(leaf_id, leaves$leaf_index)
+  value_seq <- leaves$leaf_value[li]
+  feature_seq <- character(0)
+  parent <- leaves$leaf_parent[li]
+  while (!is.na(parent) && parent >= 0) {
+    k <- match(parent, nodes$split_index)
+    if (is.na(k)) break                       # single-leaf (init) tree
+    feature_seq <- c(nodes$split_feature[k], feature_seq)
+    value_seq <- c(nodes$internal_value[k], value_seq)
+    parent <- nodes$node_parent[k]
+  }
+  data.frame(Feature = feature_seq,
+             Contribution = diff(value_seq),
+             stringsAsFactors = FALSE)
+}
+
+multiple.tree.interprete <- function(tree_dt, tree_index, leaf_index) {
+  parts <- mapply(single.tree.interprete, tree_id = tree_index,
+                  leaf_id = leaf_index,
+                  MoreArgs = list(tree_dt = tree_dt), SIMPLIFY = FALSE)
+  all_dt <- do.call(rbind, parts)
+  if (is.null(all_dt) || nrow(all_dt) == 0) {
+    return(data.frame(Feature = character(0), Contribution = numeric(0),
+                      stringsAsFactors = FALSE))
+  }
+  agg <- aggregate(Contribution ~ Feature, data = all_dt, FUN = sum)
+  agg <- agg[order(abs(agg$Contribution), decreasing = TRUE), , drop = FALSE]
+  rownames(agg) <- NULL
+  agg
+}
+
+single.row.interprete <- function(tree_dt, num_class, tree_index_mat,
+                                  leaf_index_mat) {
+  per_class <- lapply(seq_len(num_class), function(i) {
+    dt <- multiple.tree.interprete(tree_dt, tree_index_mat[, i],
+                                   leaf_index_mat[, i])
+    if (num_class > 1) {
+      names(dt)[names(dt) == "Contribution"] <- paste("Class", i - 1)
+    }
+    dt
+  })
+  if (num_class == 1) return(per_class[[1]])
+  out <- Reduce(function(x, y) merge(x, y, by = "Feature", all = TRUE),
+                per_class)
+  out[is.na(out)] <- 0
+  out
+}
+
+# lgb.plot.interpretation (R-package/R/lgb.plot.interpretation.R
+# surface): horizontal barplot(s) of the top_n absolute contributions.
+lgb.plot.interpretation <- function(tree_interpretation_dt,
+                                    top_n = 10,
+                                    cols = 1,
+                                    left_margin = 10,
+                                    cex = NULL) {
+  num_class <- ncol(tree_interpretation_dt) - 1L
+  top_n <- min(top_n, nrow(tree_interpretation_dt))
+  old <- graphics::par(no.readonly = TRUE)
+  on.exit(graphics::par(old), add = TRUE)
+  if (num_class > 1) {
+    graphics::par(mfrow = c(ceiling(num_class / cols), cols))
+  }
+  for (j in seq_len(max(num_class, 1)) + 1L) {
+    measure <- names(tree_interpretation_dt)[j]
+    top <- utils::head(
+      tree_interpretation_dt[
+        order(abs(tree_interpretation_dt[[j]]), decreasing = TRUE), ,
+        drop = FALSE], top_n)
+    top <- top[rev(seq_len(nrow(top))), , drop = FALSE]
+    graphics::par(mar = c(4, left_margin, 2, 1))
+    graphics::barplot(top[[j]], names.arg = top$Feature, horiz = TRUE,
+                      las = 1, xlab = "Contribution",
+                      main = if (num_class > 1) measure
+                             else "Feature interpretation",
+                      cex.names = cex)
+  }
+  invisible(NULL)
+}

@@ -1,0 +1,69 @@
+# lgb.prepare family: convert character/factor columns of a data.frame
+# to numeric codes (R-package/R/lgb.prepare*.R surface in base R;
+# data.table inputs are handled through their data.frame interface —
+# the package takes no data.table dependency, so conversion is
+# copy-based rather than by-reference).
+
+lgb.prepare <- function(data) {
+  data <- as.data.frame(data)
+  cls <- vapply(data, function(x) class(x)[1], character(1))
+  fix <- which(cls %in% c("character", "factor"))
+  for (i in fix) {
+    data[[i]] <- as.numeric(as.factor(data[[i]]))
+  }
+  data
+}
+
+# Integer variant (reference lgb.prepare2: "integer is smaller than
+# numeric"); same conversion, integer storage.
+lgb.prepare2 <- function(data) {
+  data <- as.data.frame(data)
+  cls <- vapply(data, function(x) class(x)[1], character(1))
+  fix <- which(cls %in% c("character", "factor"))
+  for (i in fix) {
+    data[[i]] <- as.integer(as.factor(data[[i]]))
+  }
+  data
+}
+
+# Conversion WITH reusable rules: returns list(data = , rules = );
+# pass the rules back in to convert validation/test data identically
+# (unknown levels become 0 — "excellent for sparse datasets", the
+# reference's words).
+lgb.prepare_rules <- function(data, rules = NULL) {
+  .lgbtpu_prepare_rules(data, rules, as.numeric)
+}
+
+lgb.prepare_rules2 <- function(data, rules = NULL) {
+  .lgbtpu_prepare_rules(data, rules, as.integer)
+}
+
+.lgbtpu_prepare_rules <- function(data, rules, cast) {
+  data <- as.data.frame(data)
+  if (!is.null(rules)) {
+    for (col in names(rules)) {
+      mapped <- unname(rules[[col]][as.character(data[[col]])])
+      mapped[is.na(mapped)] <- 0          # unknown levels -> 0
+      data[[col]] <- cast(mapped)
+    }
+    return(list(data = data, rules = rules))
+  }
+  cls <- vapply(data, function(x) class(x)[1], character(1))
+  fix <- which(cls %in% c("character", "factor"))
+  rules <- list()
+  for (i in fix) {
+    col <- data[[i]]
+    if (is.factor(col)) {
+      lev <- levels(col)                  # respect ordinality
+    } else {
+      lev <- levels(as.factor(unique(col)))
+    }
+    map <- seq_along(lev)
+    names(map) <- lev
+    rules[[colnames(data)[i]]] <- map
+    mapped <- unname(map[as.character(col)])
+    mapped[is.na(mapped)] <- 0
+    data[[i]] <- cast(mapped)
+  }
+  list(data = data, rules = rules)
+}

@@ -55,12 +55,24 @@ class PackPlan(NamedTuple):
 def build_pack_plan(col_num_bins) -> Optional[PackPlan]:
     """Pairing plan over physical columns: columns with <= 16 bins are
     packed two-per-byte (an odd leftover keeps a byte to itself in the
-    lo nibble); wider columns pass through.  Returns None when fewer
-    than 2 columns are packable (no traffic to save)."""
+    lo nibble); wider columns pass through.
+
+    Returns None when packing would not pay: fewer than 2 packable
+    columns, or the joint-form histogram is WIDER than the unpacked one
+    — ``storage_cols * 256 > phys_cols * B`` (B = the histogram width
+    the unpacked layout needs, i.e. the max column bins).  The single
+    inequality covers both degenerate regimes: a couple of narrow
+    columns among thousands of wide ones (the full-matrix second copy
+    would buy ~nothing), and an all-narrow dataset whose unpacked
+    histograms are tiny (B <= 16: a 256-bin joint psum/einsum would
+    move up to 8x MORE than the 2 x 16 bins it replaces)."""
     nb = np.asarray(col_num_bins, dtype=np.int64)
     fp = len(nb)
     narrow = np.flatnonzero(nb <= PACK_MAX_BIN)
     if len(narrow) < 2:
+        return None
+    n_storage = (fp - len(narrow)) + (len(narrow) + 1) // 2
+    if n_storage * PACK_JOINT_BINS > fp * int(nb.max()):
         return None
     wide = np.flatnonzero(nb > PACK_MAX_BIN)
     byte_col = np.zeros(fp, dtype=np.int32)

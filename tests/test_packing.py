@@ -30,11 +30,22 @@ def test_plan_pairs_narrow_columns():
 
 
 def test_plan_odd_leftover_and_too_few():
-    plan = build_pack_plan([10, 11, 12])
-    assert plan.num_storage_cols == 2
+    plan = build_pack_plan([255, 10, 11, 12])
+    assert plan.num_storage_cols == 3
     assert plan.num_packed == 2           # the odd column keeps its byte
     assert build_pack_plan([255, 12]) is None
     assert build_pack_plan([17, 18, 300]) is None
+
+
+def test_plan_refuses_unprofitable_packing():
+    # all-narrow: the unpacked histogram is [F, 16] — a 256-bin joint
+    # form would move 8x more per psum/einsum, so the plan must refuse
+    assert build_pack_plan([10, 11, 12]) is None
+    # two narrow among many wide: a near-full second matrix copy to
+    # save 1 byte/row of gather — refuse
+    assert build_pack_plan([255] * 2000 + [9, 9]) is None
+    # half narrow at 255-bin width: clear win — engage
+    assert build_pack_plan([255] * 8 + [9] * 8) is not None
 
 
 def test_pack_roundtrip_values():

@@ -80,7 +80,9 @@ PARITY = [
     "lgb.Dataset.set.reference", "lgb.Dataset.save",
     "lgb.train", "lgb.cv", "lgb.load", "lgb.save", "lgb.dump",
     "lgb.get.eval.result", "lgb.importance", "lgb.model.dt.tree",
-    "lgb.plot.importance", "lgb.unloader",
+    "lgb.plot.importance", "lgb.unloader", "lgb.interprete",
+    "lgb.plot.interpretation", "lgb.prepare", "lgb.prepare2",
+    "lgb.prepare_rules", "lgb.prepare_rules2",
     "predict.lgb.Booster", "slice.lgb.Dataset",
     "getinfo.lgb.Dataset", "setinfo.lgb.Dataset",
     "dim.lgb.Dataset", "dimnames.lgb.Dataset",
