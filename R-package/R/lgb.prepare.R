@@ -5,23 +5,21 @@
 # copy-based rather than by-reference).
 
 lgb.prepare <- function(data) {
-  data <- as.data.frame(data)
-  cls <- vapply(data, function(x) class(x)[1], character(1))
-  fix <- which(cls %in% c("character", "factor"))
-  for (i in fix) {
-    data[[i]] <- as.numeric(as.factor(data[[i]]))
-  }
-  data
+  .lgbtpu_prepare(data, as.numeric)
 }
 
 # Integer variant (reference lgb.prepare2: "integer is smaller than
 # numeric"); same conversion, integer storage.
 lgb.prepare2 <- function(data) {
+  .lgbtpu_prepare(data, as.integer)
+}
+
+.lgbtpu_prepare <- function(data, cast) {
   data <- as.data.frame(data)
   cls <- vapply(data, function(x) class(x)[1], character(1))
   fix <- which(cls %in% c("character", "factor"))
   for (i in fix) {
-    data[[i]] <- as.integer(as.factor(data[[i]]))
+    data[[i]] <- cast(as.factor(data[[i]]))
   }
   data
 }

@@ -251,8 +251,12 @@ def _parse_value(name: str, value: Any) -> Any:
             return None
         if isinstance(value, str):
             parts = [p for p in value.replace(",", " ").split() if p]
-        elif isinstance(value, (list, tuple)):
-            parts = list(value)
+        elif isinstance(value, (list, tuple, set, frozenset)):
+            # sets arrive from user code like metric={'l2', 'auc'}
+            # (python-guide simple_example.py); order them for
+            # deterministic eval-log column order
+            parts = (sorted(value, key=str)
+                     if isinstance(value, (set, frozenset)) else list(value))
         else:
             parts = [value]
         if name == "ndcg_eval_at":

@@ -29,6 +29,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
           early_stopping_rounds: Optional[int] = None,
           evals_result: Optional[Dict] = None,
           verbose_eval: Union[bool, int] = True,
+          learning_rates: Optional[Union[List[float], Callable]] = None,
           keep_training_booster: bool = True,
           callbacks: Optional[List[Callable]] = None) -> Booster:
     """engine.py:18-229 analogue."""
@@ -67,6 +68,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.inner.boost_from_average_ = prev.inner.boost_from_average_
 
     valid_sets = valid_sets or []
+    if isinstance(valid_sets, Dataset):
+        valid_sets = [valid_sets]    # bare Dataset (python-guide examples)
     valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
     is_valid_contain_train = False
     train_data_name = "training"
@@ -85,6 +88,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
         cbs.append(callback_mod.early_stopping(early_stopping_rounds,
                                                bool(verbose_eval)))
+    if learning_rates is not None:
+        # per-iteration schedule, list or function(iter) (reference
+        # engine.py:167-168 routes it through reset_parameter)
+        cbs.append(callback_mod.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
         cbs.append(callback_mod.record_evaluation(evals_result))
     cbs_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]

@@ -274,6 +274,12 @@ class LGBMModel(BaseEstimator):
         return self._best_iteration
 
     @property
+    def best_iteration(self) -> int:
+        """v2.0.5 sklearn attribute name (python-guide
+        sklearn_example.py uses ``gbm.best_iteration``)."""
+        return self._best_iteration
+
+    @property
     def evals_result_(self) -> Optional[Dict]:
         return self._evals_result
 

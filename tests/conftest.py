@@ -34,6 +34,7 @@ import pytest
 SLOW_MODULES = {
     "test_parallel", "test_interop", "test_multiprocess", "test_streaming",
     "test_capi_train", "test_native", "test_convert_model", "test_tpu",
+    "test_python_guide",
 }
 # individually measured >20s (full multi-model trainings); everything
 # else in their modules stays in the fast tier
