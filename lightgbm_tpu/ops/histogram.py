@@ -76,14 +76,18 @@ def subset_histogram_einsum(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 def subset_histogram_segment(rows: jnp.ndarray, g: jnp.ndarray,
                              h: jnp.ndarray, c: jnp.ndarray,
                              num_bins: int,
-                             rows_per_chunk: int = 16384) -> jnp.ndarray:
+                             rows_per_chunk: int = 2048) -> jnp.ndarray:
     """Histogram via scatter-add (``segment_sum``) over the combined
     (feature, bin) index — O(M·F) adds instead of the einsum's O(M·F·B)
     MACs.  This IS the reference's dense_bin.hpp:66-132 accumulation in
     XLA form; scatter lowers well on CPU (where the fallback rungs run)
     but poorly on TPU, which is exactly why the TPU path is the MXU
     one-hot contraction instead.  Chunked over rows (like the einsum
-    path) so the transient [chunk·F, 3] update buffer stays bounded."""
+    path) so the transient [chunk·F, 3] update buffer stays cache-sized:
+    measured on the 1-core bench host at 256k x 28 x 255, 2048 rows/chunk
+    runs 1.6x faster than 16384 (95 vs 152 ns/row — the [chunk*F, 3]
+    scatter source fits L2 next to the 85 KB accumulator; 4096 already
+    regresses)."""
     rows = rows.astype(jnp.int32)
     m, f = rows.shape
     w = jnp.stack([g, h, c], axis=-1)                    # [M, 3]
