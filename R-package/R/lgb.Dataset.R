@@ -14,7 +14,7 @@ lgb.Dataset <- function(data,
                         free_raw_data = TRUE,
                         info = list(),
                         ...) {
-  info <- modifyList(info, list(...))
+  info <- utils::modifyList(info, list(...))
   env <- new.env(parent = emptyenv())
   env$raw_data <- data
   env$params <- params
@@ -42,7 +42,7 @@ lgb.Dataset.create.valid <- function(dataset, data, info = list(), ...) {
                        colnames = dataset$colnames,
                        categorical_feature = dataset$categorical_feature,
                        free_raw_data = dataset$free_raw_data,
-                       info = modifyList(info, list(...)))
+                       info = utils::modifyList(info, list(...)))
   valid
 }
 

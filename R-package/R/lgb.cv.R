@@ -125,7 +125,7 @@ generate.cv.folds <- function(nfold, nrows, stratified, label, group,
     ng <- length(group)
     gfold <- sample(rep(seq_len(nfold), length.out = ng))
     ends <- cumsum(group)
-    starts <- c(1, head(ends, -1) + 1)
+    starts <- c(1, utils::head(ends, -1) + 1)
     return(lapply(seq_len(nfold), function(k) {
       unlist(lapply(which(gfold == k),
                     function(g) seq(starts[g], ends[g])))

@@ -53,7 +53,7 @@ multiple.tree.interprete <- function(tree_dt, tree_index, leaf_index) {
     return(data.frame(Feature = character(0), Contribution = numeric(0),
                       stringsAsFactors = FALSE))
   }
-  agg <- aggregate(Contribution ~ Feature, data = all_dt, FUN = sum)
+  agg <- stats::aggregate(Contribution ~ Feature, data = all_dt, FUN = sum)
   agg <- agg[order(abs(agg$Contribution), decreasing = TRUE), , drop = FALSE]
   rownames(agg) <- NULL
   agg
