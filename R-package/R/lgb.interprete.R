@@ -13,8 +13,8 @@ lgb.interprete <- function(model,
                            num_iteration = NULL) {
   tree_dt <- lgb.model.dt.tree(model, num_iteration)
   num_class <- .lgbtpu_num_class(model$model_string)
-  leafs <- predict(model, as.matrix(data)[idxset, , drop = FALSE],
-                   num_iteration = num_iteration, predleaf = TRUE)
+  leafs <- stats::predict(model, as.matrix(data)[idxset, , drop = FALSE],
+                          num_iteration = num_iteration, predleaf = TRUE)
   leafs <- matrix(leafs, nrow = length(idxset))
   lapply(seq_along(idxset), function(i) {
     single.row.interprete(

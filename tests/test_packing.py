@@ -84,6 +84,36 @@ def test_unfold_matches_direct_histogram():
         np.testing.assert_allclose(out[f], direct, rtol=1e-6, atol=1e-5)
 
 
+def test_unfold_composes_with_pallas_kernel_interpret():
+    """The TPU path histograms PACKED storage columns with the Pallas
+    kernel at the 256-wide joint index; interpret mode pins that
+    combination (kernel x packing) without a chip: joint histograms
+    from the kernel, unfolded, must equal per-feature histograms
+    computed directly."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.pallas_hist import subset_histogram_pallas
+    rng = np.random.RandomState(2)
+    nb = [255, 9, 16, 5, 13]
+    n = 600
+    binned = np.stack([rng.randint(0, b, size=n) for b in nb],
+                      axis=1).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = np.abs(rng.randn(n)).astype(np.float32)
+    c = np.ones(n, np.float32)
+    plan = build_pack_plan(nb)
+    packed = pack_columns(binned, plan)
+    hist_c = subset_histogram_pallas(jnp.asarray(packed), jnp.asarray(g),
+                                     jnp.asarray(h), jnp.asarray(c), 256,
+                                     feat_tile=2, row_tile=512,
+                                     interpret=True)
+    out = np.asarray(unfold_packed_hist(hist_c, plan, 255))
+    w = np.stack([g, h, c], axis=1)
+    for f in range(len(nb)):
+        direct = np.zeros((255, 3), np.float32)
+        np.add.at(direct, binned[:, f], w)
+        np.testing.assert_allclose(out[f], direct, rtol=2e-5, atol=2e-4)
+
+
 def _narrow_problem(n=4000, seed=3):
     """Mixed matrix: 2 wide continuous columns + 10 small-cardinality
     columns (<=16 bins) + 2 small categoricals."""
