@@ -122,6 +122,63 @@ def test_namespace_exports_are_defined(our_fns):
     assert not missing, f"NAMESPACE exports undefined functions: {missing}"
 
 
+def test_r_eval_log_parsing_contract(tmp_path):
+    """The R binding parses record_evals and best_iter out of the CLI's
+    stderr/stdout with fixed regexes; run a REAL CLI training with a
+    validation set + early stopping and assert those exact patterns
+    (read out of the R sources, not re-typed here) match the live log
+    — the contract that would silently rot if the log format drifted."""
+    import re
+    utils_r = open(os.path.join(REPO, "R-package", "R", "utils.R")).read()
+    train_r = open(os.path.join(REPO, "R-package", "R", "lgb.train.R")).read()
+
+    def r_patterns(src):
+        # R string literal -> regex: \\ is a backslash, \t a tab
+        return [p.replace("\\\\", "\\")
+                for p in re.findall(r'regexec\("((?:[^"\\]|\\.)*)"', src)]
+
+    iter_pat, part_pat = r_patterns(utils_r)
+    best_pat = [p for p in r_patterns(train_r) if "best iteration" in p]
+    assert best_pat, "best-iteration pattern not found in lgb.train.R"
+    best_pat = best_pat[0]
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(1200, 5)
+    y = (X[:, 0] + 0.2 * rng.randn(1200) > 0).astype(np.float64)
+    np.savetxt(tmp_path / "tr.tsv", np.column_stack([y, X])[:900],
+               delimiter="\t")
+    np.savetxt(tmp_path / "va.tsv", np.column_stack([y, X])[900:],
+               delimiter="\t")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.cli", "task=train",
+         f"data={tmp_path / 'tr.tsv'}", f"valid_data={tmp_path / 'va.tsv'}",
+         "objective=binary", "metric=auc,binary_logloss", "num_trees=30",
+         "num_leaves=7", "early_stopping_round=3", "verbose=1",
+         f"output_model={tmp_path / 'm.txt'}"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-1000:]
+    log = (r.stdout + r.stderr).splitlines()
+
+    eval_lines = [ln for ln in log if re.search(iter_pat, ln)]
+    assert len(eval_lines) >= 3, "no eval lines matched the R iter pattern"
+    parsed = 0
+    for ln in eval_lines:
+        body = re.search(iter_pat, ln)
+        assert body.group(1).isdigit()
+        for part in body.group(2).split("\t"):
+            pm = re.match(part_pat, part)
+            assert pm, f"R part pattern failed on {part!r}"
+            assert pm.group(2) in ("auc", "binary_logloss")
+            float(pm.group(3))
+            parsed += 1
+    assert parsed >= 6
+    best = [re.search(best_pat, ln) for ln in log]
+    best = [m for m in best if m]
+    assert best, "early stopping fired but the R best-iter pattern missed it"
+    assert int(best[-1].group(1)) >= 1
+
+
 def test_cli_dump_model_task(tmp_path):
     """The R package's lgb.dump rides `task=dump_model`; prove the CLI
     produces parseable JSON with the documented top-level keys."""
