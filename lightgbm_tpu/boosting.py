@@ -266,9 +266,8 @@ class GBDT:
                      "%d global (padded) rows", jax.process_count(), n,
                      self._global_rows)
         elif cfg.tree_learner in ("data", "voting", "data_feature"):
-            row_shards = (int(mesh.shape["data"])
-                          if cfg.tree_learner == "data_feature" else shards)
-            self._row_pad = pad_rows(n, row_shards)
+            # on the 2-D mesh rows shard over the "data" axis only
+            self._row_pad = pad_rows(n, int(mesh.shape.get("data", shards)))
             self.bins = (jnp.pad(self.bins, ((0, self._row_pad), (0, 0)))
                          if self._row_pad else jnp.asarray(self.bins))
             if self._hist_bins is not None:
@@ -279,9 +278,8 @@ class GBDT:
         if cfg.tree_learner in ("feature", "data_feature"):
             bundled = self.meta.col is not None
             ncols = int(np.shape(self.bins)[1])
-            col_shards = (int(mesh.shape["feature"])
-                          if cfg.tree_learner == "data_feature" else shards)
-            col_pad = pad_features(ncols, col_shards)
+            col_pad = pad_features(ncols,
+                                   int(mesh.shape.get("feature", shards)))
             # pad PHYSICAL columns; bundled logical meta stays intact
             # (no logical feature maps to a pad column)
             binned = np.asarray(self.bins)

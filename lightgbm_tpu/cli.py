@@ -203,7 +203,8 @@ def run_dump_model(cfg: Config, params: Dict[str, str]) -> None:
     import json
     if not cfg.input_model:
         log.fatal("No model specified (input_model=...)")
-    out_path = (cfg.convert_model if cfg.convert_model != "gbdt_prediction.cpp"
+    out_path = (cfg.convert_model
+                if cfg.convert_model != Config().convert_model
                 else cfg.input_model + ".json")
     booster = Booster(model_file=cfg.input_model, params=params)
     with open(out_path, "w") as f:
