@@ -63,13 +63,23 @@ lgb.save <- function(booster, filename, num_iteration = NULL) {
   keep_trees <- (num_iteration + .lgbtpu_has_init_tree(lines)) * nc
   starts <- grep("^Tree=", lines)
   if (length(starts) <= keep_trees) return(lines)
-  trailer <- grep("^feature importances:", lines)
-  cut <- starts[keep_trees + 1]
-  head_part <- lines[1:(cut - 1)]
-  if (length(trailer)) {
-    head_part <- c(head_part, lines[trailer[1]:length(lines)])
+  head_part <- lines[1:(starts[keep_trees + 1] - 1)]
+  # recompute the split-count importance trailer from the KEPT trees
+  # (the reference recomputes on save; carrying the full model's
+  # counts over would misreport the truncated model)
+  feat_names <- .lgbtpu_feature_names(lines)
+  counts <- integer(length(feat_names))
+  for (kv in .lgbtpu_parse_trees(head_part)) {
+    gains <- .lgbtpu_field_num(kv, "split_gain")
+    sf <- as.integer(.lgbtpu_field_num(kv, "split_feature")) + 1L
+    used <- sf[gains > 0]
+    for (f in used) counts[f] <- counts[f] + 1L
   }
-  head_part
+  trailer <- "feature importances:"
+  ord <- order(counts, decreasing = TRUE)
+  ord <- ord[counts[ord] > 0]
+  c(head_part, trailer,
+    paste0(feat_names[ord], "=", counts[ord]))
 }
 
 .lgbtpu_num_trees <- function(booster) {
