@@ -166,6 +166,11 @@ lgb.get.eval.result <- function(booster, data_name, eval_name, iters = NULL,
          "; recorded: ", paste(names(rec), collapse = ", "))
   }
   values <- if (is.list(entry)) {
+    if (is_err && !length(entry$eval_err)) {
+      stop("lgb.get.eval.result: no error (sd) recorded for ",
+           sQuote(eval_name), " (single-run training records no sd; ",
+           "use lgb.cv for fold spread)")
+    }
     unlist(if (is_err) entry$eval_err else entry$eval)
   } else {
     if (is_err) stop("lgb.get.eval.result: no error (sd) recorded")

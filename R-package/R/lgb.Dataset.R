@@ -49,6 +49,11 @@ lgb.Dataset.create.valid <- function(dataset, data, info = list(), ...) {
 # Materialize the dataset as reference-format files in `dir`; returns
 # the data path.  Side files follow src/io/metadata.cpp conventions.
 .lgbtpu_construct_in <- function(dataset, dir, name = "data") {
+  # already materialized (lgb.Dataset.construct, or an earlier train on
+  # the same object) and not invalidated since: reuse the files instead
+  # of re-serializing the matrix
+  cp <- dataset$constructed_path
+  if (!is.null(cp) && file.exists(cp)) return(cp)
   path <- file.path(dir, paste0(name, ".tsv"))
   has_side <- !is.null(dataset$info$weight) ||
     !is.null(dataset$info$group) || !is.null(dataset$info$init_score)

@@ -28,6 +28,7 @@ lgb.cv <- function(params = list(),
                    callbacks = list(),
                    ...) {
   params <- append(params, list(...))
+  if (!is.null(obj)) params$objective <- obj   # folds consult the objective
   if (!lgb.is.Dataset(data)) {
     if (is.null(label)) {
       stop("lgb.cv: data must be an lgb.Dataset, or supply label= with a ",
@@ -64,12 +65,16 @@ lgb.cv <- function(params = list(),
     boosters[[k]] <- list(booster = bst)
   }
 
-  # merge: mean + sd across folds per metric per iteration
+  # merge: mean + sd across folds per metric per iteration; folds can
+  # in principle log different iteration counts (aborted runs), so
+  # align on the shortest rather than letting matrix() recycle
   metrics <- names(per_fold[[1]])
   record_evals <- list(valid = list())
   for (m in metrics) {
-    vals <- sapply(per_fold, function(r) unlist(r[[m]]$eval))  # [iter, fold]
-    vals <- matrix(vals, ncol = nfold)
+    cols <- lapply(per_fold, function(r) unlist(r[[m]]$eval))
+    n_it <- min(vapply(cols, length, integer(1)))
+    vals <- vapply(cols, function(v) v[seq_len(n_it)], numeric(n_it))
+    vals <- matrix(vals, nrow = n_it)
     means <- rowMeans(vals)
     sds <- apply(vals, 1, stats::sd)
     record_evals$valid[[m]] <- list(eval = as.list(means),
@@ -143,13 +148,22 @@ generate.cv.folds <- function(nfold, nrows, stratified, label, group,
 }
 
 lgb.stratified.folds <- function(y, k = 10) {
-  # proportional allocation of each class across folds (caret-style,
-  # like the reference's lgb.stratified.folds)
+  # caret-style stratification exactly like the reference
+  # (lgb.cv.R:370-428): numeric labels are quantile-binned into at most
+  # 5 magnitude groups first, then each group is balanced across folds
+  if (is.numeric(y) && length(unique(y)) > k) {
+    cuts <- max(2, min(5, floor(length(y) / k)))
+    y <- cut(y, unique(stats::quantile(y, probs = seq(0, 1,
+                                                      length.out = cuts))),
+             include.lowest = TRUE)
+  }
+  # sample() on a length-1 vector means sample(1:x) — always index
+  resample <- function(x) x[sample.int(length(x))]
   fold_of <- integer(length(y))
   for (cls in unique(y)) {
     members <- which(y == cls)
-    fold_of[members] <- sample(rep(seq_len(k),
-                                   length.out = length(members)))
+    fold_of[members] <- resample(rep(seq_len(k),
+                                     length.out = length(members)))
   }
   lapply(seq_len(k), function(f) which(fold_of == f))
 }

@@ -75,11 +75,13 @@ lgb.train <- function(params = list(),
 
   model_file <- file.path(work, "model.txt")
   cat_idx <- .lgbtpu_cat_indices(data)
-  # record_evals is parsed from the engine's eval log, so the CLI must
-  # emit it (verbose=1) whenever recording is on — system2 captures the
-  # output, and only verbose >= 1 echoes it to the R console below
+  # record_evals AND the early-stopping best-iteration message are
+  # parsed from the engine's log, so the CLI must emit info-level
+  # output whenever either is needed — system2 captures it, and only
+  # verbose >= 1 echoes the eval lines to the R console below
   have_evals <- length(vfiles) > 0 || want_train_metric
-  cli_verbose <- if (verbose >= 1 || (record && have_evals)) 1 else -1
+  cli_verbose <- if (verbose >= 1 || (record && have_evals)
+                     || !is.null(early_stopping_rounds)) 1 else -1
   args <- c("task=train",
             paste0("data=", train_file),
             paste0("output_model=", model_file),
@@ -123,14 +125,15 @@ lgb.train <- function(params = list(),
   es <- Filter(length, es)
   if (length(es)) {
     booster$best_iter <- as.integer(es[[length(es)]][2])
-    first_set <- names(booster$record_evals)
-    if (length(first_set)) {
-      entry <- booster$record_evals[[first_set[1]]]
-      if (length(entry)) {
-        vals <- unlist(entry[[1]]$eval)
-        if (booster$best_iter <= length(vals)) {
-          booster$best_score <- vals[booster$best_iter]
-        }
+    # the log holds one entry per LOGGED iteration (eval_freq spacing),
+    # so look the score up by iteration NUMBER, not by position
+    parsed <- .lgbtpu_parse_eval_log(log)
+    if (length(parsed$sets)) {
+      first <- parsed$sets[[1]]
+      iters <- unique(parsed$iter)
+      pos <- match(booster$best_iter, iters)
+      if (!is.na(pos) && length(first)) {
+        booster$best_score <- first[[1]][pos]
       }
     }
   }

@@ -64,10 +64,11 @@
 
 # Reference side-file convention (src/io/metadata.cpp): one value per
 # line in <data>.weight / <data>.query / <data>.init next to the data.
+# Full double precision — init scores feed continued training and must
+# survive the file transport bit-faithfully (%.17g round-trips f64).
 .lgbtpu_write_side <- function(path, ext, values) {
   if (is.null(values)) return(invisible(NULL))
-  writeLines(format(values, scientific = FALSE, trim = TRUE),
-             paste0(path, ".", ext))
+  writeLines(sprintf("%.17g", as.numeric(values)), paste0(path, ".", ext))
   invisible(NULL)
 }
 

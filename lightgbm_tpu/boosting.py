@@ -785,7 +785,9 @@ class GBDT:
             buf.write(self.models[i].to_string(i))
             buf.write("\n")
         buf.write("\nfeature importances:\n")
-        imp = self.feature_importance()
+        # importances over the KEPT trees only (gbdt.cpp:989
+        # FeatureImportance(num_used_model))
+        imp = self.feature_importance(num_iteration=num_iteration)
         order = np.argsort(-imp, kind="mergesort")
         for f in order:
             if imp[f] > 0:

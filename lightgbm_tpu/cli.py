@@ -14,7 +14,8 @@ from typing import Dict, List
 import numpy as np
 
 from .basic import Booster, Dataset
-from .config import Config, config_from_params, parse_config_file
+from .config import (Config, canonicalize_params, config_from_params,
+                     parse_config_file)
 from .engine import train as train_fn
 from .utils import log
 
@@ -203,9 +204,10 @@ def run_dump_model(cfg: Config, params: Dict[str, str]) -> None:
     import json
     if not cfg.input_model:
         log.fatal("No model specified (input_model=...)")
-    out_path = (cfg.convert_model
-                if cfg.convert_model != Config().convert_model
-                else cfg.input_model + ".json")
+    # explicit convert_model= (under any alias) wins even if it equals
+    # the converter default; otherwise default to <input_model>.json
+    given = "convert_model" in canonicalize_params(params)
+    out_path = cfg.convert_model if given else cfg.input_model + ".json"
     booster = Booster(model_file=cfg.input_model, params=params)
     with open(out_path, "w") as f:
         json.dump(booster.dump_model(), f)
