@@ -1,52 +1,92 @@
 #!/bin/bash
 # One-shot TPU evidence capture: run the moment the axon tunnel is alive.
 # Orders the work so the most valuable artifact (a BENCH number) lands
-# first — the tunnel has died mid-session twice; assume it can again.
+# first, and COMMITS after every artifact — the tunnel has died
+# mid-session three rounds running; assume it will again.
+#
+# CAPTURE_REHEARSAL=1  skip the TPU probe and shrink shapes so the whole
+#                      script rehearses end-to-end on CPU (~15 min);
+#                      catches script bugs before a real tunnel window.
+# CAPTURE_COMMIT=0     disable the per-artifact git commits.
 set -u
 cd "$(dirname "$0")/.."
 STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+REHEARSAL=${CAPTURE_REHEARSAL:-0}
+DO_COMMIT=${CAPTURE_COMMIT:-1}
 OUT=docs/tpu_capture_${STAMP}
+[ "$REHEARSAL" = "1" ] && OUT=/tmp/tpu_capture_rehearsal_${STAMP} DO_COMMIT=0
 mkdir -p "$OUT"
 
+snap() {  # commit the evidence gathered so far
+    if [ "$DO_COMMIT" = "1" ]; then
+        git add "$OUT" >/dev/null 2>&1 && \
+        git commit -q -m "TPU capture ${STAMP}: $1
+
+No-Verification-Needed: measurement artifacts only" || true
+    fi
+}
+
 echo "== probe ==" | tee "$OUT/log.txt"
-if ! timeout 120 python -c "import jax; print(jax.devices())" \
-        >> "$OUT/log.txt" 2>&1; then
-    echo "TPU unreachable; aborting capture" | tee -a "$OUT/log.txt"
-    exit 1
+if [ "$REHEARSAL" = "1" ]; then
+    echo "rehearsal mode: probe skipped, CPU shapes" | tee -a "$OUT/log.txt"
+    ROWS=100000 WIDE_ROWS=20000 WIDE_COLS=400 SPARSE_ROWS=50000 TREES=3
+    PROFILE_ROWS=100000
+else
+    if ! timeout 120 python -c "import jax; print(jax.devices())" \
+            >> "$OUT/log.txt" 2>&1; then
+        echo "TPU unreachable; aborting capture" | tee -a "$OUT/log.txt"
+        exit 1
+    fi
+    ROWS=1000000 WIDE_ROWS=200000 WIDE_COLS=2000 SPARSE_ROWS=1000000 TREES=5
+    PROFILE_ROWS=1000000
 fi
 
 echo "== bench 1M (tpu+pallas) ==" | tee -a "$OUT/log.txt"
-BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
+BENCH_ROWS=$ROWS BENCH_ROWS_CPU=$ROWS BENCH_STAGE_TIMEOUT=2400 \
+    timeout 2700 python bench.py \
     > "$OUT/bench_1m.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_1m.json" | tee -a "$OUT/log.txt"
+snap "headline bench"
 
 echo "== on-chip test tier ==" | tee -a "$OUT/log.txt"
-LGBM_TPU_TESTS_ON_TPU=1 timeout 900 python -m pytest tests/test_tpu.py -q \
-    >> "$OUT/log.txt" 2>&1
+if [ "$REHEARSAL" = "1" ]; then
+    # rehearse the command line; the tier self-skips off-chip
+    timeout 900 python -m pytest tests/test_tpu.py -q \
+        >> "$OUT/log.txt" 2>&1
+else
+    LGBM_TPU_TESTS_ON_TPU=1 timeout 1200 python -m pytest tests/test_tpu.py \
+        -q >> "$OUT/log.txt" 2>&1
+fi
 tail -2 "$OUT/log.txt"
+snap "on-chip test tier"
 
-echo "== bench wide (Epsilon-shaped 200k x 2000) ==" | tee -a "$OUT/log.txt"
-BENCH_ROWS=200000 BENCH_FEATURES=2000 BENCH_TREES=5 \
-    BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
+echo "== bench wide (Epsilon-shaped) ==" | tee -a "$OUT/log.txt"
+BENCH_ROWS=$WIDE_ROWS BENCH_ROWS_CPU=$WIDE_ROWS BENCH_FEATURES=$WIDE_COLS \
+    BENCH_TREES=$TREES BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
     > "$OUT/bench_wide.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_wide.json" | tee -a "$OUT/log.txt"
+snap "wide bench"
 
 echo "== bench sparse (EFB + nibble packing) ==" | tee -a "$OUT/log.txt"
-BENCH_SPARSITY=0.9 BENCH_FEATURES=100 BENCH_TREES=5 \
+BENCH_ROWS=$SPARSE_ROWS BENCH_ROWS_CPU=$SPARSE_ROWS BENCH_SPARSITY=0.9 \
+    BENCH_FEATURES=100 BENCH_TREES=$TREES \
     BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
     > "$OUT/bench_sparse.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_sparse.json" | tee -a "$OUT/log.txt"
 
 echo "== bench sparse A/B: packing OFF (docs/MEMORY.md decision) ==" \
     | tee -a "$OUT/log.txt"
-BENCH_SPARSITY=0.9 BENCH_FEATURES=100 BENCH_TREES=5 \
+BENCH_ROWS=$SPARSE_ROWS BENCH_ROWS_CPU=$SPARSE_ROWS BENCH_SPARSITY=0.9 \
+    BENCH_FEATURES=100 BENCH_TREES=$TREES \
     BENCH_EXTRA_PARAMS=enable_bin_packing=false \
     BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
     > "$OUT/bench_sparse_nopack.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_sparse_nopack.json" | tee -a "$OUT/log.txt"
+snap "sparse bench + packing A/B"
 
 echo "== profile sweep ==" | tee -a "$OUT/log.txt"
-timeout 1800 python scripts/tpu_profile.py 1000000 \
+timeout 1800 python scripts/tpu_profile.py $PROFILE_ROWS \
     >> "$OUT/log.txt" 2>&1
+snap "profile sweep"
 
 echo "capture complete: $OUT" | tee -a "$OUT/log.txt"
