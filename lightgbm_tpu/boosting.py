@@ -58,12 +58,23 @@ class GBDT:
     average_output = False
     sub_model_name = "tree"
     allow_boost_from_average = True
+    # DART reads/mutates prior trees every iteration and RF feeds host
+    # gradients; both stay on the synchronous path
+    pipeline_supported = True
 
     def __init__(self, config: Config, train_set: Optional[TrainingData] = None,
                  objective: Optional[Objective] = None):
         self.config = config
         self.train_set = train_set
         self.objective = objective
+        # tree-materialization pipeline state (see train_one_iter): grown
+        # trees wait in _pending as device TreeArrays and drain into _models
+        # a few iterations late through ONE batched transfer each
+        self._pending: List[dict] = []
+        self._pipeline = False
+        self._pipeline_depth = 3
+        self._stopped_no_split = False
+        self._iter_had_split = False
         self.models: List[Tree] = []
         self.timers = PhaseTimers()   # TIMETAG analogue (gbdt.cpp:22-64)
         self.iter_ = 0
@@ -81,6 +92,75 @@ class GBDT:
                                 else 0)
         if train_set is not None:
             self._setup_device(train_set)
+
+    # ------------------------------------------------- pipelined tree pulling
+    #
+    # ``models`` drains pending device-side trees on every read, so every
+    # consumer (save/predict/importance/rollback/bindings) always sees the
+    # complete, ordered list; only the training hot loop uses ``_models`` /
+    # ``_pending`` directly.
+
+    @property
+    def models(self) -> List[Tree]:
+        if self._pending:
+            self._drain_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value) -> None:
+        if getattr(self, "_pending", None):
+            self._drain_pending()
+        self._models = list(value)
+
+    def _drain_pending(self, keep_iters: int = 0) -> None:
+        """Materialize pending trees (FIFO) until at most ``keep_iters``
+        iteration groups remain.  Each materialization is one batched
+        ``jax.device_get`` whose transfer was started asynchronously at
+        dispatch time, so by the time a record is ``keep_iters`` iterations
+        old the bytes are normally already on host."""
+        keep = keep_iters * self.num_class
+        if self._stopped_no_split:
+            keep = 0            # everything still pending must be reverted
+        while self._pending and len(self._pending) > keep:
+            rec = self._pending.pop(0)
+            host = jax.device_get(rec["arrays"])
+            tree = Tree.from_arrays(host, self.train_set.used_features,
+                                    self.train_set.bin_mappers,
+                                    self._num_bin_host)
+            tree.shrink(rec["lr"])
+            if self._stopped_no_split:
+                # trained past a (lately discovered) no-split iteration:
+                # discard, undoing any score contribution it made
+                self._revert_tree_scores(rec["k"], tree)
+                continue
+            self._models.append(tree)
+            if tree.num_leaves > 1:
+                self._iter_had_split = True
+            if rec["k"] == self.num_class - 1:
+                if not self._iter_had_split:
+                    # the reference stops at the first iteration whose trees
+                    # cannot split (gbdt.cpp:541-556); reproduce its exact
+                    # final state — drop this iteration's trees and rewind
+                    log.warning("Stopped training because there are no more "
+                                "leaves that meet the split requirements")
+                    for _ in range(self.num_class):
+                        self._models.pop()
+                    self._stopped_no_split = True
+                    self.iter_ = rec["iter"]
+                    keep = 0    # later pending trees are all discarded
+                self._iter_had_split = False
+
+    def _revert_tree_scores(self, k: int, tree: Tree) -> None:
+        """Subtract a discarded tree's contribution (rollback_one_iter's
+        arithmetic) from train and valid scores."""
+        if tree.num_leaves <= 1:
+            return
+        tree.shrink(-1.0)
+        self.scores = self.scores.at[k].add(self._train_tree_score(tree))
+        for vs in self.valid_sets:
+            vs.scores = vs.scores.at[k].add(tree_scores_binned(
+                vs.bins, tree, self.used_feature_index, self.feat_info,
+                self.train_set.bin_mappers))
 
     # ------------------------------------------------------------------ setup
 
@@ -106,6 +186,7 @@ class GBDT:
              jnp.asarray(fm["default_bin"]), jnp.asarray(col),
              jnp.asarray(off)], axis=1)
         self.used_feature_index = {f: i for i, f in enumerate(train.used_features)}
+        self._num_bin_host = np.asarray(fm["num_bin"])
         self.num_data = train.num_data
         n = self.num_data
 
@@ -131,6 +212,8 @@ class GBDT:
             min_cat_smooth=cfg.min_cat_smooth,
             max_cat_smooth=cfg.max_cat_smooth)
         self._setup_grower(cfg, train)
+        self._pipeline = (cfg.pipeline_trees and self.pipeline_supported
+                          and not self._multiproc)
 
         self.objective.init(train.metadata, n)
         self.num_class = self.objective.num_tree_per_iteration
@@ -462,18 +545,32 @@ class GBDT:
                 and not self.boost_from_average_):
             self._boost_from_average()
 
-        # each phase blocks on its outputs so async dispatch does not
-        # misattribute device time to the next phase
+        # pipelined mode never blocks in the loop: every phase is an async
+        # dispatch and freshly grown trees drain to host a few iterations
+        # late (one batched transfer each).  Synchronous mode blocks each
+        # phase on its outputs so async dispatch does not misattribute
+        # device time to the next phase.
+        # custom gradients stay synchronous: the caller computed them from
+        # the CURRENT prediction state, so a lately-discovered no-split
+        # rewind must never invalidate iterations their fobj already saw
+        pipeline = self._pipeline and grad is None and hess is None
+        if not pipeline and self._pending:
+            self._drain_pending()           # never interleave modes
+            if self._stopped_no_split:
+                self._stopped_no_split = False
+                return True
         with self.timers.phase("boosting"):
             if grad is None or hess is None:
                 g, h = self._grad_fn(self.scores)
             else:
                 g = jnp.asarray(grad, jnp.float32).reshape(self.num_class, -1)
                 h = jnp.asarray(hess, jnp.float32).reshape(self.num_class, -1)
-            jax.block_until_ready((g, h))
+            if not pipeline:
+                jax.block_until_ready((g, h))
         with self.timers.phase("bagging"):
             g, h, cnt = self._sample(self.iter_, g, h)
-            jax.block_until_ready((g, h, cnt))
+            if not pipeline:
+                jax.block_until_ready((g, h, cnt))
 
         lr = self._shrinkage_rate()
         any_split = False
@@ -504,17 +601,28 @@ class GBDT:
                         self._dist_row_vec(h[k] * self._bag_weight),
                         self._dist_row_vec(cnt), self.meta, feat_mask)
                     row_leaf = self._local_rows(row_leaf)
-                if self._multiproc:
-                    # tree arrays are replicated — pull to host once so the
-                    # local scoring/predict paths see process-local data
-                    arrays = jax.tree.map(np.asarray, arrays)
-                num_leaves = int(arrays.num_leaves)
-                tree = Tree.from_arrays(arrays, self.train_set.used_features,
-                                        self.train_set.bin_mappers,
-                                        np.asarray(self.meta.num_bin))
-                tree.shrink(lr)
-                self.models.append(tree)
-            if num_leaves > 1:
+                if pipeline:
+                    # start the host copy NOW; the batched device_get a few
+                    # iterations later finds the bytes already landed
+                    jax.tree.map(
+                        lambda a: getattr(a, "copy_to_host_async",
+                                          lambda: None)(), arrays)
+                else:
+                    if self._multiproc:
+                        # tree arrays are replicated — pull to host once so
+                        # the local scoring/predict paths see process-local
+                        # data
+                        arrays = jax.tree.map(np.asarray, arrays)
+                    num_leaves = int(arrays.num_leaves)
+                    tree = Tree.from_arrays(
+                        arrays, self.train_set.used_features,
+                        self.train_set.bin_mappers, self._num_bin_host)
+                    tree.shrink(lr)
+                    self._models.append(tree)
+            # pipelined: the split/no-split outcome is unknown on host, but
+            # a no-split tree's leaf_value is all zeros so the score update
+            # is a provable no-op — dispatch it unconditionally
+            if pipeline or num_leaves > 1:
                 any_split = True
                 with self.timers.phase("score"):
                     if self._subset_state is not None:
@@ -542,15 +650,28 @@ class GBDT:
                         vs.scores = vs.scores.at[k].set(self._update_score(
                             vs.scores[k], arrays.leaf_value, vleaf,
                             jnp.asarray(lr, jnp.float32)))
-                    jax.block_until_ready(self.scores)
+                    if not pipeline:
+                        jax.block_until_ready(self.scores)
+            if pipeline:
+                self._pending.append(
+                    {"iter": self.iter_, "k": k, "arrays": arrays, "lr": lr})
         self._after_iter()
         self.iter_ += 1
+        if pipeline:
+            with self.timers.phase("tree"):
+                self._drain_pending(keep_iters=self._pipeline_depth)
+            if self._stopped_no_split:
+                # one-shot, like the sync path: a later call retries (a
+                # reset_parameter / rollback may have re-enabled splitting)
+                self._stopped_no_split = False
+                return True
+            return False
         if not any_split:
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
             # remove the useless trees of this iteration
             for _ in range(self.num_class):
-                self.models.pop()
+                self._models.pop()
             self.iter_ -= 1
             return True
         return False
@@ -858,6 +979,8 @@ class DART(GBDT):
     (no SubModelName override exists in the reference; a DART model file IS
     just its trees, already normalized)."""
 
+    pipeline_supported = False   # reads/shrinks prior trees every iteration
+
     def __init__(self, config, train_set=None, objective=None):
         super().__init__(config, train_set, objective)
         self._drop_rng = make_rng(config.drop_seed)
@@ -977,7 +1100,13 @@ class DART(GBDT):
 
 
 class GOSS(GBDT):
-    """goss.hpp — Gradient-based One-Side Sampling."""
+    """goss.hpp — Gradient-based One-Side Sampling.
+
+    Stays pipeline-eligible: ``_sample`` pulls the gradient magnitudes to
+    host each post-warmup iteration (the top-k threshold is a host
+    decision, like the reference's), but that sync never forces TREE
+    materialization — the per-tree batched-transfer saving applies in
+    full."""
 
     def _sample(self, it, g, h):
         cfg = self.config
@@ -1016,6 +1145,7 @@ class RF(GBDT):
     gradients always computed from the zero score, no boost-from-average."""
     average_output = True
     allow_boost_from_average = False
+    pipeline_supported = False   # feeds host-side gradients every iteration
 
     def __init__(self, config, train_set=None, objective=None):
         super().__init__(config, train_set, objective)

@@ -227,6 +227,18 @@ class Config:
     pallas_feat_tile: int = 8      # kernel grid: features per block
     pallas_row_tile: int = 512     # kernel grid: rows per block
     pallas_bucket_min_log2: int = 10   # smallest pow2 gather bucket
+    # pipeline tree materialization: keep freshly grown trees on device and
+    # pull them to host a few iterations late (one batched async transfer
+    # per tree) so the training loop never blocks on device->host latency.
+    # Matters enormously when the accelerator sits behind a high-latency
+    # link; synchronous fallback happens automatically for DART/RF,
+    # multi-process meshes, and custom-gradient training.  The final model
+    # is always bit-identical to the synchronous path; the one observable
+    # difference is that a mid-run "no more leaves" stop is DETECTED up to
+    # a few iterations late, so per-iteration callbacks may see evals for
+    # iterations that are then rewound (tests/test_pipeline.py pins the
+    # rewind to the exact synchronous final state).
+    pipeline_trees: bool = True
 
     # file-task fields (CLI)
     data: str = ""

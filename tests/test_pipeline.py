@@ -1,0 +1,159 @@
+"""Pipelined tree materialization (config: pipeline_trees).
+
+The training loop can leave freshly grown trees on device and pull them to
+host a few iterations late (boosting.py train_one_iter pipeline branch +
+_drain_pending).  These tests pin the contract: pipelining is an execution
+strategy, never an observable one — models, scores, and the no-split stop
+point must match the synchronous path exactly (the reference semantics,
+gbdt.cpp:465-581 and :541-556).
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import config_from_params
+from lightgbm_tpu.data.dataset import construct
+from lightgbm_tpu.objectives import create_objective
+
+
+def _make_binary(n=2000, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = ((X @ rng.randn(f)) > 0).astype(np.float32)
+    return X, y
+
+
+def _train(X, y, params, iters):
+    cfg = config_from_params(dict(params, verbose=-1))
+    ds = construct(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg))
+    stopped_at = None
+    for i in range(iters):
+        if b.train_one_iter():
+            stopped_at = i
+            break
+    return b, stopped_at
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1}
+
+
+def test_pipelined_training_is_bit_identical():
+    """Same model text + same device scores with the pipeline on and off,
+    including stochastic bagging/feature sampling (identical RNG streams)."""
+    X, y = _make_binary()
+    params = dict(BASE, bagging_fraction=0.7, bagging_freq=1,
+                  feature_fraction=0.8)
+    b1, _ = _train(X, y, dict(params, pipeline_trees=True), 12)
+    b2, _ = _train(X, y, dict(params, pipeline_trees=False), 12)
+    assert b1.save_model_to_string() == b2.save_model_to_string()
+    np.testing.assert_array_equal(np.asarray(b1.scores),
+                                  np.asarray(b2.scores))
+    assert b1.iter_ == b2.iter_
+
+
+def test_pipelined_no_split_stop_matches_sync():
+    """A mid-run iteration whose tree cannot split stops training; the
+    pipelined path discovers this a few iterations late and must rewind to
+    the exact synchronous final state (models, iter count, scores)."""
+    # tiny data + high min_gain: gains shrink as residuals do, so training
+    # exhausts well before the iteration cap
+    rng = np.random.RandomState(3)
+    X = np.repeat(rng.randn(12, 3), 12, axis=0).astype(np.float32)
+    y = ((X @ np.array([1.0, -1.0, 0.5])) > 0).astype(np.float32)
+    params = dict(BASE, num_leaves=8, min_data_in_leaf=1,
+                  min_gain_to_split=0.15, learning_rate=0.5)
+    b1, stop1 = _train(X, y, dict(params, pipeline_trees=True), 60)
+    b2, stop2 = _train(X, y, dict(params, pipeline_trees=False), 60)
+    assert stop2 is not None, "sync run must exhaust (fixture broken)"
+    assert stop1 is not None, "pipelined run never stopped"
+    assert b1.iter_ == b2.iter_
+    assert len(b1.models) == len(b2.models)
+    assert b1.save_model_to_string() == b2.save_model_to_string()
+    np.testing.assert_allclose(np.asarray(b1.scores), np.asarray(b2.scores),
+                               atol=1e-6)
+
+
+def test_models_access_mid_training_drains():
+    """Reading .models mid-training must materialize every grown tree (the
+    drain-on-access property) so predict/save see a complete model."""
+    X, y = _make_binary(800, 6, seed=5)
+    cfg = config_from_params(dict(BASE, pipeline_trees=True, verbose=-1))
+    ds = construct(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg))
+    for i in range(5):
+        b.train_one_iter()
+        # binary has no boost-from-average init tree in v2.0.5 semantics
+        assert len(b.models) == i + 1
+        assert all(t.num_leaves >= 1 for t in b.models)
+    # predict mid-training uses the drained list
+    p = b.predict(X[:16])
+    assert p.shape == (16,)
+    assert np.isfinite(p).all()
+
+
+def test_pipelined_multiclass_identical():
+    """num_class > 1: per-iteration groups of K trees drain in order."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(1200, 8).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] > 0).astype(np.float32) \
+        + (X[:, 2] > 0.5).astype(np.float32)
+    params = dict(BASE, objective="multiclass", num_class=3, num_leaves=7)
+    b1, _ = _train(X, y, dict(params, pipeline_trees=True), 6)
+    b2, _ = _train(X, y, dict(params, pipeline_trees=False), 6)
+    assert b1.save_model_to_string() == b2.save_model_to_string()
+    np.testing.assert_array_equal(np.asarray(b1.scores),
+                                  np.asarray(b2.scores))
+
+
+def test_custom_gradients_force_sync():
+    """User-supplied grad/hess are computed from the CURRENT predictions,
+    so those iterations must run synchronously (and drain anything pending
+    first so modes never interleave)."""
+    X, y = _make_binary(600, 5, seed=11)
+    cfg = config_from_params(dict(BASE, pipeline_trees=True, verbose=-1))
+    ds = construct(X, cfg, label=y)
+    b = create_boosting(cfg, ds, create_objective(cfg))
+    b.train_one_iter()                       # pipelined: leaves one pending
+    assert b._pending
+    g = np.zeros_like(y) + 0.1
+    h = np.ones_like(y)
+    b.train_one_iter(g, h)                   # custom grads: sync + drained
+    assert not b._pending
+    assert len(b._models) == 2
+
+
+def test_no_split_stop_is_not_sticky():
+    """After a no-split stop the next call retries (reset_parameter or
+    rollback may have re-enabled splitting) instead of returning True from
+    a latched flag."""
+    rng = np.random.RandomState(3)
+    X = np.repeat(rng.randn(12, 3), 12, axis=0).astype(np.float32)
+    y = ((X @ np.array([1.0, -1.0, 0.5])) > 0).astype(np.float32)
+    params = dict(BASE, num_leaves=8, min_data_in_leaf=1,
+                  min_gain_to_split=0.15, learning_rate=0.5,
+                  pipeline_trees=True)
+    b, stop = _train(X, y, params, 60)
+    assert stop is not None
+    n_models, it = len(b.models), b.iter_
+    # retries instead of returning True from a latched flag; the re-run
+    # exhausts again and (lag-late, like any pipelined stop) reports it
+    stopped = any(b.train_one_iter() for _ in range(b._pipeline_depth + 2))
+    assert stopped
+    assert len(b.models) == n_models and b.iter_ == it
+
+
+def test_dart_rf_fall_back_to_sync():
+    """DART mutates prior trees per iteration and RF feeds host gradients:
+    both must refuse the pipeline (exact-semantics fallback)."""
+    X, y = _make_binary(600, 5, seed=9)
+    for extra in ({"boosting_type": "dart"},
+                  {"boosting_type": "rf", "bagging_fraction": 0.6,
+                   "bagging_freq": 1}):
+        cfg = config_from_params(dict(BASE, pipeline_trees=True,
+                                      verbose=-1, **extra))
+        ds = construct(X, cfg, label=y)
+        b = create_boosting(cfg, ds, create_objective(cfg))
+        assert not b._pipeline
+        b.train_one_iter()
+        assert not b._pending
