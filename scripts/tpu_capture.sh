@@ -41,6 +41,12 @@ else
     PROFILE_ROWS=1000000
 fi
 
+echo "== microprobe (latency vs device time) ==" | tee -a "$OUT/log.txt"
+timeout 1800 python scripts/tpu_microprobe.py $PROFILE_ROWS \
+    > "$OUT/microprobe.json" 2>> "$OUT/log.txt"
+cat "$OUT/microprobe.json" | tee -a "$OUT/log.txt"
+snap "microprobe"
+
 echo "== bench 1M (tpu+pallas) ==" | tee -a "$OUT/log.txt"
 BENCH_ROWS=$ROWS BENCH_ROWS_CPU=$ROWS BENCH_STAGE_TIMEOUT=2400 \
     timeout 2700 python bench.py \

@@ -1,0 +1,158 @@
+"""Fine-grained TPU timing probe: separates link latency from device time.
+
+The headline bench conflates three costs the tunnel-attached TPU makes very
+different: per-dispatch+sync round-trip latency, device->host transfer time,
+and actual on-device execution.  This probe times each in isolation so the
+next optimization targets the real bottleneck (the reference's analogue is
+the GPU learner's per-phase timing, gpu_tree_learner.cpp + TIMETAG):
+
+  1. round-trip latency of a trivial jitted op (dispatch + block);
+  2. pipelined dispatch rate (N dispatches, one block) - the cost floor of
+     an async training loop;
+  3. subset_histogram (Pallas) at several row counts, amortized: the hot op;
+  4. the gather / cumsum / scatter trio the partition is built from, at the
+     root-split window size;
+  5. grow_tree end-to-end, amortized over 5 calls with ONE final block;
+  6. train_one_iter through the booster (pipelined), 10 iters.
+
+Writes one JSON dict to stdout (plus progress on stderr); tpu_capture.sh
+saves it as evidence.  Runs on whatever backend jax picks - on CPU it is a
+rehearsal, numbers are only meaningful on the chip.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _t(fn, n=1, warmup=True):
+    """Wall time of fn() x n with one final block, after an optional
+    warmup call (compile excluded)."""
+    import jax
+    if warmup:
+        jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    import jax
+    import jax.numpy as jnp
+    res = {"platform": jax.devices()[0].platform, "rows": rows}
+    print(f"platform: {res['platform']}", file=sys.stderr, flush=True)
+
+    # 1. round-trip latency ---------------------------------------------------
+    one = jnp.ones((8,), jnp.float32)
+    add = jax.jit(lambda x: x + 1)
+    res["rtt_ms"] = _t(lambda: add(one), n=10) * 1e3
+    # transfer sync: device_get of a tiny array
+    res["device_get_tiny_ms"] = _t(lambda: jax.device_get(add(one)), n=10) * 1e3
+    print(f"rtt {res['rtt_ms']:.1f} ms, tiny device_get "
+          f"{res['device_get_tiny_ms']:.1f} ms", file=sys.stderr, flush=True)
+
+    # 2. pipelined dispatch rate ---------------------------------------------
+    def burst():
+        x = one
+        for _ in range(50):
+            x = add(x)
+        return x
+    res["dispatch_pipelined_ms"] = _t(burst, n=1) * 1e3 / 50
+    print(f"pipelined dispatch {res['dispatch_pipelined_ms']:.2f} ms/op",
+          file=sys.stderr, flush=True)
+
+    # 3. histogram op at several sizes ---------------------------------------
+    from lightgbm_tpu.ops.histogram import subset_histogram
+    rng = np.random.RandomState(0)
+    f = 28
+    method = "pallas" if res["platform"] == "tpu" else "segment"
+    res["hist_method"] = method
+    bins_full = jnp.asarray(rng.randint(0, 255, size=(rows, f), dtype=np.uint8))
+    res["hist_ms"] = {}
+    # multiples of 2048 (the segment method's chunk; also a pallas row_tile
+    # multiple), capped at the probe size
+    sizes = sorted({min(m, rows) // 2048 * 2048
+                    for m in (1 << 17, 1 << 19, rows)})
+    for m in sizes:
+        sub = bins_full[:m]
+        g = jnp.ones((m,), jnp.float32)
+        fn = jax.jit(lambda b, gg: subset_histogram(b, gg, gg, gg, 255,
+                                                    method=method))
+        res["hist_ms"][str(m)] = _t(lambda: fn(sub, g), n=5) * 1e3
+        print(f"hist {m} rows: {res['hist_ms'][str(m)]:.1f} ms",
+              file=sys.stderr, flush=True)
+
+    # 4. partition primitives at the root window size ------------------------
+    n = rows
+    order = jnp.asarray(np.arange(n, dtype=np.int32))
+    perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+    goes_left = jnp.asarray(rng.rand(n) < 0.5)
+
+    take_fn = jax.jit(lambda o: jnp.take(bins_full, o, axis=0))
+    res["gather_rows_ms"] = _t(lambda: take_fn(perm), n=5) * 1e3
+
+    def part(ord_, gl):
+        c1 = jnp.cumsum(gl.astype(jnp.int32))
+        c0 = jnp.cumsum((~gl).astype(jnp.int32))
+        nl = c1[-1]
+        rank = jnp.where(gl, c1 - 1, nl + c0 - 1)
+        return jnp.zeros((n,), jnp.int32).at[rank].set(ord_)
+    part_fn = jax.jit(part)
+    res["partition_window_ms"] = _t(lambda: part_fn(order, goes_left), n=5) * 1e3
+    print(f"gather {res['gather_rows_ms']:.1f} ms, partition window "
+          f"{res['partition_window_ms']:.1f} ms", file=sys.stderr, flush=True)
+
+    # 5 + 6. the real grower and booster -------------------------------------
+    sys.path.insert(0, ".")
+    from bench import make_data
+    from lightgbm_tpu.config import config_from_params
+    from lightgbm_tpu.data.dataset import construct
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.utils import log as _log
+    _log.set_verbosity(-1)
+    X, y = make_data(rows, f)
+    cfg = config_from_params({
+        "objective": "binary", "num_leaves": 255, "max_bin": 255,
+        "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100,
+        "learning_rate": 0.1, "verbose": -1,
+        "use_pallas": res["platform"] == "tpu"})
+    ds = construct(X, cfg, label=y)
+    bst = create_boosting(cfg, ds, create_objective(cfg))
+
+    gmat = bst.bins
+    g0, h0 = bst._grad_fn(bst.scores)
+    cnt = jnp.ones((rows,), jnp.float32)
+    fv = jnp.ones(bst._num_bin_host.shape[0], bool)
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        bst.grow(gmat, g0[0], h0[0], cnt, bst.meta, fv)[0].num_leaves)
+    res["grow_compile_s"] = time.perf_counter() - t0
+    res["grow_ms"] = _t(
+        lambda: bst.grow(gmat, g0[0], h0[0], cnt, bst.meta, fv)[0].num_leaves,
+        n=5, warmup=False) * 1e3
+    print(f"grow compile {res['grow_compile_s']:.0f} s, grow "
+          f"{res['grow_ms']:.0f} ms/tree", file=sys.stderr, flush=True)
+
+    n_it = 10
+    bst.train_one_iter()            # warm the full-iteration path
+    t0 = time.perf_counter()
+    for _ in range(n_it):
+        bst.train_one_iter()
+    bst._drain_pending()
+    jax.block_until_ready(bst.scores)
+    res["train_iter_ms"] = (time.perf_counter() - t0) / n_it * 1e3
+    res["pipelined"] = bool(bst._pipeline)
+    print(f"train_one_iter {res['train_iter_ms']:.0f} ms "
+          f"(pipelined={res['pipelined']})", file=sys.stderr, flush=True)
+
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+#!/bin/bash
+# TPU tunnel watcher: probe with a short timeout on a loop; the moment the
+# tunnel answers, fire scripts/tpu_capture.sh (which commits evidence after
+# every artifact).  The tunnel has died mid-session four rounds running -
+# assume every live window is the last and capture immediately.
+#
+#   nohup bash scripts/tpu_watch.sh >> /tmp/tpu_watch.log 2>&1 &
+#
+# Env: WATCH_INTERVAL (s, default 540), WATCH_ONCE=1 (exit after one capture)
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${WATCH_INTERVAL:-540}
+while true; do
+    if timeout 90 python -c \
+            "import jax; assert jax.devices()[0].platform == 'tpu'" \
+            >/dev/null 2>&1; then
+        echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing"
+        bash scripts/tpu_capture.sh
+        echo "$(date -u +%H:%M:%S) capture finished (rc=$?)"
+        [ "${WATCH_ONCE:-1}" = "1" ] && exit 0
+    else
+        echo "$(date -u +%H:%M:%S) tunnel down"
+    fi
+    sleep "$INTERVAL"
+done
