@@ -1,0 +1,70 @@
+#!/bin/bash
+# Remaining TPU evidence after the headline bench is in the bank:
+# microprobe (the latency-vs-device-time diagnosis) FIRST, then the
+# profile sweep, then the wide/sparse coverage benches.  Commits after
+# every artifact; same assumptions as tpu_capture.sh (tunnel can die at
+# any moment, most valuable artifact first).  Stages are deliberately
+# duplicated from tpu_capture.sh rather than parameterized: during a
+# live tunnel window a standalone, already-rehearsed script beats
+# editing the primary capture path.  Fire via
+#   CAPTURE_SCRIPT=scripts/tpu_capture_phase2.sh bash scripts/tpu_watch.sh
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+DO_COMMIT=${CAPTURE_COMMIT:-1}
+OUT=docs/tpu_capture_${STAMP}
+mkdir -p "$OUT"
+
+snap() {
+    if [ "$DO_COMMIT" = "1" ]; then
+        git add "$OUT" >/dev/null 2>&1 && \
+        git commit -q -m "TPU capture ${STAMP}: $1
+
+No-Verification-Needed: measurement artifacts only" || true
+    fi
+}
+
+echo "== probe ==" | tee "$OUT/log.txt"
+if ! timeout 120 python -c "import jax; print(jax.devices())" \
+        >> "$OUT/log.txt" 2>&1; then
+    echo "TPU unreachable; aborting capture" | tee -a "$OUT/log.txt"
+    rm -rf "$OUT"
+    exit 1
+fi
+
+echo "== microprobe (latency vs device time) ==" | tee -a "$OUT/log.txt"
+timeout 1800 python scripts/tpu_microprobe.py 1000000 \
+    > "$OUT/microprobe.json" 2>> "$OUT/log.txt"
+cat "$OUT/microprobe.json" | tee -a "$OUT/log.txt"
+snap "microprobe"
+
+echo "== profile sweep ==" | tee -a "$OUT/log.txt"
+timeout 1800 python scripts/tpu_profile.py 1000000 \
+    >> "$OUT/log.txt" 2>&1
+tail -40 "$OUT/log.txt"
+snap "profile sweep"
+
+echo "== bench wide (Epsilon-shaped) ==" | tee -a "$OUT/log.txt"
+BENCH_ROWS=200000 BENCH_ROWS_CPU=200000 BENCH_FEATURES=2000 \
+    BENCH_TREES=5 BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
+    > "$OUT/bench_wide.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_wide.json" | tee -a "$OUT/log.txt"
+snap "wide bench"
+
+echo "== bench sparse (EFB + nibble packing) ==" | tee -a "$OUT/log.txt"
+BENCH_ROWS=1000000 BENCH_ROWS_CPU=1000000 BENCH_SPARSITY=0.9 \
+    BENCH_FEATURES=100 BENCH_TREES=5 \
+    BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
+    > "$OUT/bench_sparse.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_sparse.json" | tee -a "$OUT/log.txt"
+
+BENCH_ROWS=1000000 BENCH_ROWS_CPU=1000000 BENCH_SPARSITY=0.9 \
+    BENCH_FEATURES=100 BENCH_TREES=5 \
+    BENCH_EXTRA_PARAMS=enable_bin_packing=false \
+    BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
+    > "$OUT/bench_sparse_nopack.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_sparse_nopack.json" | tee -a "$OUT/log.txt"
+snap "sparse bench + packing A/B"
+
+echo "capture ${STAMP} complete" | tee -a "$OUT/log.txt"
+snap "final log"

@@ -20,8 +20,11 @@ saves it as evidence.  Runs on whatever backend jax picks - on CPU it is a
 rehearsal, numbers are only meaningful on the chip.
 """
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -108,7 +111,6 @@ def main():
           f"{res['partition_window_ms']:.1f} ms", file=sys.stderr, flush=True)
 
     # 5 + 6. the real grower and booster -------------------------------------
-    sys.path.insert(0, ".")
     from bench import make_data
     from lightgbm_tpu.config import config_from_params
     from lightgbm_tpu.data.dataset import construct

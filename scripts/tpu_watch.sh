@@ -6,16 +6,19 @@
 #
 #   nohup bash scripts/tpu_watch.sh >> /tmp/tpu_watch.log 2>&1 &
 #
-# Env: WATCH_INTERVAL (s, default 540), WATCH_ONCE=1 (exit after one capture)
+# Env: WATCH_INTERVAL (s, default 540), WATCH_ONCE=1 (exit after one capture),
+#      CAPTURE_SCRIPT (default scripts/tpu_capture.sh; set to
+#      scripts/tpu_capture_phase2.sh once the headline bench is banked)
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL=${WATCH_INTERVAL:-540}
+CAPTURE=${CAPTURE_SCRIPT:-scripts/tpu_capture.sh}
 while true; do
     if timeout 90 python -c \
             "import jax; assert jax.devices()[0].platform == 'tpu'" \
             >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing"
-        bash scripts/tpu_capture.sh
+        bash "$CAPTURE"
         echo "$(date -u +%H:%M:%S) capture finished (rc=$?)"
         [ "${WATCH_ONCE:-1}" = "1" ] && exit 0
     else
