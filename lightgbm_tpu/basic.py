@@ -520,8 +520,12 @@ class Dataset:
     # is DATA, never executable (unlike pickle).
     BINARY_TOKEN = b"lightgbm_tpu.dataset.v2\n"
 
-    def save_binary(self, filename: str) -> "Dataset":
-        """Binary dataset cache (Dataset::SaveBinaryFile analogue)."""
+    def save_binary(self, filename: str, compress: bool = True) -> "Dataset":
+        """Binary dataset cache (Dataset::SaveBinaryFile analogue).
+
+        ``compress=False`` skips zlib (the reference's binary file is also
+        raw) — random bin indices barely compress and the deflate pass
+        dominates save time on large matrices."""
         import io
         import json
         c = self.constructed
@@ -557,7 +561,7 @@ class Dataset:
             if val is not None:
                 arrays[key] = np.asarray(val)
         buf = io.BytesIO()
-        np.savez_compressed(buf, **arrays)
+        (np.savez_compressed if compress else np.savez)(buf, **arrays)
         with open(filename, "wb") as f:
             f.write(Dataset.BINARY_TOKEN)
             f.write(buf.getvalue())

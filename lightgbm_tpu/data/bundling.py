@@ -131,21 +131,28 @@ class BundleLayout:
         return max(self.col_num_bin) if self.col_num_bin else 1
 
 
-def build_bundled_column(data: np.ndarray, bundle: List[int], mappers,
+def build_bundled_column(data, bundle: List[int], mappers,
                          offsets: List[int], dtype,
                          bin_buf: Optional[np.ndarray] = None) -> np.ndarray:
     """Bin + merge one bundle's features into a single column.
 
-    ``offsets[i]`` is the first slot of ``bundle[i]``; conflicting rows take
-    the LAST feature's value (the reference also resolves conflicts by
-    overwrite, PushData order)."""
-    n = data.shape[0]
+    ``data`` is either the raw ``[N, F]`` matrix or a mapping of feature
+    index -> contiguous float64 column (the construction path pre-transposes
+    column blocks for cache efficiency).  ``offsets[i]`` is the first slot of
+    ``bundle[i]``; conflicting rows take the LAST feature's value (the
+    reference also resolves conflicts by overwrite, PushData order)."""
+    def column(j):
+        if isinstance(data, dict):
+            return data[j]
+        return np.asarray(data[:, j], dtype=np.float64)
+
+    n = len(column(bundle[0]))
     col = np.zeros(n, dtype=dtype)
     if bin_buf is None:
         bin_buf = np.empty(n, dtype=dtype)
     for j, off in zip(bundle, offsets):
         m = mappers[j]
-        m.bin_into(np.asarray(data[:, j], dtype=np.float64), bin_buf)
+        m.bin_into(column(j), bin_buf)
         b = bin_buf.astype(np.int32)
         db = m.default_bin
         nondef = b != db

@@ -83,6 +83,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 
     lib.GBTN_BinColumn.restype = None
     lib.GBTN_BinColumn.argtypes = [c_d_p, c_ll, c_d_p, c_i, c_i, c_i, c_p]
+    lib.GBTN_GreedyFindBin.restype = c_i
+    lib.GBTN_GreedyFindBin.argtypes = [c_d_p, c_ll_p, c_i, c_i, c_ll, c_i,
+                                       c_d_p]
     lib.GBTN_BinColumnCategorical.restype = None
     lib.GBTN_BinColumnCategorical.argtypes = [c_d_p, c_ll, c_ll_p, c_i_p,
                                               c_i, c_i, c_i, c_p]
@@ -299,6 +302,24 @@ def parse_file(path: str, has_header: bool, label_idx: int
         return feats, labels
     finally:
         lib.GBTN_ParsedFree(h)
+
+
+def greedy_find_bin(distinct: np.ndarray, counts: np.ndarray, max_bin: int,
+                    total_cnt: int, min_data_in_bin: int):
+    """Native greedy bin-boundary search; None when the library is absent
+    (caller falls back to the pure-Python loop in data/binning.py)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    distinct = np.ascontiguousarray(distinct, dtype=np.float64)
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    out = np.empty(max(int(max_bin), 1), dtype=np.float64)
+    n = lib.GBTN_GreedyFindBin(
+        distinct.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        len(distinct), int(max_bin), int(total_cnt), int(min_data_in_bin),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out[:n].tolist()
 
 
 def bin_column(values: np.ndarray, bounds: np.ndarray, n_search: int,

@@ -23,6 +23,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <fstream>
+#include <limits>
 #include <map>
 #include <sstream>
 #include <string>
@@ -416,6 +417,78 @@ void GBTN_BinColumn(const double* values, long long n, const double* bounds,
     if (out_bits == 8) static_cast<uint8_t*>(out)[i] = (uint8_t)b;
     else static_cast<uint16_t*>(out)[i] = (uint16_t)b;
   }
+}
+
+// Greedy equal-count bin boundary search over (distinct value, count)
+// pairs — the hot inner loop of BinMapper fitting (bin.cpp:72-141
+// semantics, mirroring data/binning.py::greedy_find_bin exactly; the
+// Python loop costs ~17 ms per continuous feature at 50k distinct
+// values, which dominates wide-dataset construction).  Writes at most
+// max(max_bin, 1) boundaries (last one +inf) into out; returns the count.
+int GBTN_GreedyFindBin(const double* distinct, const long long* counts,
+                       int num_distinct, int max_bin, long long total_cnt,
+                       int min_data_in_bin, double* out) {
+  const double kInf = std::numeric_limits<double>::infinity();
+  int n_out = 0;
+  if (max_bin <= 0) {
+    out[n_out++] = kInf;
+    return n_out;
+  }
+  if (num_distinct <= max_bin) {
+    long long cur = 0;
+    for (int i = 0; i < num_distinct - 1; ++i) {
+      cur += counts[i];
+      if (cur >= min_data_in_bin) {
+        out[n_out++] = (distinct[i] + distinct[i + 1]) / 2.0;
+        cur = 0;
+      }
+    }
+    out[n_out++] = kInf;
+    return n_out;
+  }
+  if (min_data_in_bin > 0) {
+    long long cap = total_cnt / min_data_in_bin;
+    if (cap < max_bin) max_bin = (int)cap;
+    if (max_bin < 1) max_bin = 1;
+  }
+  double mean_bin_size = (double)total_cnt / max_bin;
+  std::vector<char> is_big(num_distinct);
+  int rest_bin_cnt = max_bin;
+  long long rest_sample_cnt = total_cnt;
+  for (int i = 0; i < num_distinct; ++i) {
+    is_big[i] = (double)counts[i] >= mean_bin_size;
+    if (is_big[i]) {
+      --rest_bin_cnt;
+      rest_sample_cnt -= counts[i];
+    }
+  }
+  mean_bin_size = (double)rest_sample_cnt / std::max(rest_bin_cnt, 1);
+  std::vector<double> upper(max_bin, kInf), lower(max_bin, kInf);
+  int bin_cnt = 0;
+  lower[0] = distinct[0];
+  long long cur = 0;
+  for (int i = 0; i < num_distinct - 1; ++i) {
+    if (!is_big[i]) rest_sample_cnt -= counts[i];
+    cur += counts[i];
+    if (is_big[i] || (double)cur >= mean_bin_size ||
+        (is_big[i + 1] &&
+         (double)cur >= std::max(1.0, mean_bin_size * 0.5))) {
+      upper[bin_cnt] = distinct[i];
+      ++bin_cnt;
+      lower[bin_cnt] = distinct[i + 1];
+      if (bin_cnt >= max_bin - 1) break;
+      cur = 0;
+      if (!is_big[i]) {
+        --rest_bin_cnt;
+        mean_bin_size = (double)rest_sample_cnt / std::max(rest_bin_cnt, 1);
+      }
+    }
+  }
+  bin_cnt += 1;
+  for (int i = 0; i < bin_cnt - 1; ++i)
+    out[n_out++] = (upper[i] + lower[i + 1]) / 2.0;
+  out[n_out++] = kInf;
+  return n_out;
 }
 
 // Categorical value->bin through a sorted (category, bin) table.

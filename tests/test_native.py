@@ -64,6 +64,29 @@ def test_bin_column_parity():
     np.testing.assert_array_equal(ref, out)
 
 
+def test_greedy_find_bin_parity():
+    """Native GBTN_GreedyFindBin vs the pure-Python oracle, exact, across
+    regimes: continuous (all counts 1), heavy-hitter ("big count" pinning),
+    few-distinct, and min_data_in_bin capping."""
+    from lightgbm_tpu.data.binning import greedy_find_bin_py
+    rng = np.random.RandomState(3)
+    cases = []
+    v = np.sort(rng.randn(40000))
+    cases.append((v, np.ones(len(v), np.int64), 255, len(v), 3))
+    d = np.sort(rng.randn(5000))
+    c = rng.randint(1, 4, size=5000).astype(np.int64)
+    c[::97] = 4000          # big-count values get their own bin
+    cases.append((d, c, 255, int(c.sum()), 3))
+    small = np.arange(10, dtype=np.float64)
+    cases.append((small, np.full(10, 5, np.int64), 63, 50, 3))
+    cases.append((d[:2000], c[:2000], 15, int(c[:2000].sum()), 200))
+    for distinct, counts, max_bin, total, mdib in cases:
+        got = native.greedy_find_bin(distinct, counts, max_bin, total, mdib)
+        want = greedy_find_bin_py(distinct, counts, max_bin, total, mdib)
+        assert got is not None
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_bin_into_categorical_parity():
     rng = np.random.RandomState(2)
     v = rng.randint(0, 30, size=10000).astype(np.float64)
