@@ -141,6 +141,32 @@ def main():
     print(f"grow compile {res['grow_compile_s']:.0f} s, grow "
           f"{res['grow_ms']:.0f} ms/tree", file=sys.stderr, flush=True)
 
+    # 5b. rows-sweep decomposition: grow wall ~ a + b*rows at fixed 255
+    # leaves, so the intercept a / 254 splits is the per-split FIXED cost
+    # (kernel-launch / small-op overhead in the while-loop body) and b the
+    # per-row work — the two candidate explanations for the measured
+    # ~850 ms/tree separated without trace tooling
+    res["grow_ms_by_rows"] = {str(int(rows)): res["grow_ms"]}
+    for m in sorted({rows // 16, rows // 4}):
+        mm = max(4096, m // 2048 * 2048)
+        if mm >= rows:        # degenerate at tiny rehearsal sizes
+            continue
+        fn = (lambda mm: lambda: bst.grow(
+            gmat[:mm], g0[0][:mm], h0[0][:mm], cnt[:mm], bst.meta,
+            fv)[0].num_leaves)(mm)
+        res["grow_ms_by_rows"][str(mm)] = _t(fn, n=3) * 1e3
+        print(f"grow at {mm} rows: {res['grow_ms_by_rows'][str(mm)]:.0f} ms",
+              file=sys.stderr, flush=True)
+    xs = np.array(sorted(float(k) for k in res["grow_ms_by_rows"]))
+    ys = np.array([res["grow_ms_by_rows"][str(int(x))] for x in xs])
+    if len(xs) >= 2:
+        b_slope, a_icept = np.polyfit(xs, ys, 1)
+        res["grow_per_split_fixed_ms"] = max(a_icept, 0.0) / 254
+        res["grow_per_mrow_ms"] = b_slope * 1e6
+        print(f"decomposition: per-split fixed "
+              f"{res['grow_per_split_fixed_ms']:.3f} ms, per-Mrow "
+              f"{res['grow_per_mrow_ms']:.0f} ms", file=sys.stderr, flush=True)
+
     n_it = 10
     bst.train_one_iter()            # warm the full-iteration path
     t0 = time.perf_counter()

@@ -19,6 +19,9 @@ def make_data(n, f=28, seed=42):
     return bench_make(n, f)
 
 
+_DS_CACHE = {}
+
+
 def train_tps(X, y, n_timed=10, **extra_params):
     import jax
     from lightgbm_tpu.config import config_from_params
@@ -33,7 +36,17 @@ def train_tps(X, y, n_timed=10, **extra_params):
                   learning_rate=0.1, verbose=-1, use_pallas=True)
     params.update(extra_params)
     cfg = config_from_params(params)
-    ds = construct(X, cfg, label=y)
+    # the sweep varies only kernel/grower knobs — the binned dataset is
+    # identical across configs; construct once (tunnel minutes are
+    # precious).  Key on every binning-relevant field so a future sweep
+    # over binning knobs cannot silently reuse a stale dataset.
+    ck = (id(X), cfg.max_bin, cfg.min_data_in_bin,
+          cfg.bin_construct_sample_cnt, cfg.data_random_seed,
+          cfg.enable_bundle, cfg.max_conflict_rate, cfg.use_missing,
+          cfg.zero_as_missing)
+    if ck not in _DS_CACHE:
+        _DS_CACHE[ck] = construct(X, cfg, label=y)
+    ds = _DS_CACHE[ck]
     bst = create_boosting(cfg, ds, create_objective(cfg))
     t0 = time.perf_counter()
     bst.train_one_iter()
