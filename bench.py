@@ -108,7 +108,8 @@ def _construct_cached(X, y, cfg, n_rows, n_feat, sparsity, params):
                 "native/gbt_native.cpp"):
         with open(os.path.join(pkg, rel), "rb") as f:
             vh.update(f.read())
-    bundle_on = str(params["enable_bundle"]).lower() in ("true", "1")
+    bundle_on = str(params.get("enable_bundle", False)).lower() in ("true",
+                                                                    "1")
     key = (f"r{n_rows}_f{n_feat}_s{sparsity}_b{params['max_bin']}"
            f"_e{int(bundle_on)}_x{xh}_v{vh.hexdigest()[:8]}")
     path = os.path.join(cache_dir, key + ".bin")
@@ -284,6 +285,31 @@ def _tpu_reachable(timeout_s: int) -> bool:
     return False
 
 
+def _attach_last_tpu_capture(res: dict) -> None:
+    """When the TPU rung degraded, point at the newest COMMITTED on-chip
+    bench artifact (docs/tpu_capture_*/bench_1m.json) — clearly labeled as
+    evidence from an earlier live-tunnel window, not this run.  The tunnel
+    has died mid-session four rounds running; this keeps a dead tunnel at
+    measurement time from reading as 'no TPU number exists'."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for p in sorted(glob.glob(os.path.join(
+            here, "docs", "tpu_capture_*", "bench_1m.json"))):
+        try:
+            with open(p) as f:
+                d = json.loads(f.read().strip().splitlines()[-1])
+            if "(tpu" in d.get("metric", ""):
+                best = (os.path.relpath(p, here), d)
+        except (OSError, json.JSONDecodeError, IndexError):
+            continue
+    if best is not None:
+        res["last_committed_tpu_capture"] = {
+            "note": "measured during an earlier live-tunnel window, "
+                    "not this run",
+            "artifact": best[0], **best[1]}
+
+
 def main():
     if os.environ.get("BENCH_CHILD") == "1":
         child_main()
@@ -302,10 +328,12 @@ def main():
                              "probe failed" for p, q in ladder if p == "tpu")
         ladder = [r for r in ladder if r[0] != "tpu"]
         if not ladder:   # BENCH_PLATFORM=tpu forced but unreachable
-            print(json.dumps({
+            res = {
                 "metric": "higgs-like binary GBDT training throughput",
                 "value": 0.0, "unit": "trees/sec", "vs_baseline": 0.0,
-                "degraded": dropped}))
+                "degraded": dropped}
+            _attach_last_tpu_capture(res)
+            print(json.dumps(res))
             return
         os.environ["BENCH_TPU_SKIPPED"] = dropped
     errors = []
@@ -318,18 +346,21 @@ def main():
                 res["degraded"] = ("fell back to "
                                    f"{platform}{'+pallas' if pallas else ''}: "
                                    + " ; ".join(errors))
+                _attach_last_tpu_capture(res)
             print(json.dumps(res))
             return
         errors.append(res)
         sys.stderr.write(f"bench: rung failed — {res}\n")
     # every rung failed: still print the one JSON line (driver contract)
-    print(json.dumps({
+    res = {
         "metric": "higgs-like binary GBDT training throughput",
         "value": 0.0,
         "unit": "trees/sec",
         "vs_baseline": 0.0,
         "degraded": "all rungs failed: " + " ; ".join(errors),
-    }))
+    }
+    _attach_last_tpu_capture(res)
+    print(json.dumps(res))
 
 
 if __name__ == "__main__":

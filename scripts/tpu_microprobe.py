@@ -151,9 +151,12 @@ def main():
         mm = max(4096, m // 2048 * 2048)
         if mm >= rows:        # degenerate at tiny rehearsal sizes
             continue
-        fn = (lambda mm: lambda: bst.grow(
-            gmat[:mm], g0[0][:mm], h0[0][:mm], cnt[:mm], bst.meta,
-            fv)[0].num_leaves)(mm)
+        # slice OUTSIDE the timed region — in-region slices would scale
+        # with mm and contaminate the per-row slope being measured
+        sub = (gmat[:mm], g0[0][:mm], h0[0][:mm], cnt[:mm])
+        jax.block_until_ready(sub)
+        fn = (lambda sub: lambda: bst.grow(
+            *sub, bst.meta, fv)[0].num_leaves)(sub)
         res["grow_ms_by_rows"][str(mm)] = _t(fn, n=3) * 1e3
         print(f"grow at {mm} rows: {res['grow_ms_by_rows'][str(mm)]:.0f} ms",
               file=sys.stderr, flush=True)
