@@ -29,6 +29,7 @@ from .config import Config
 from .data.dataset import TrainingData
 from .grower import FeatureMeta, GrowerConfig, make_grower
 from .metrics import Metric, create_metric, default_metric_for_objective
+from .ops.histogram import on_tpu
 from .objectives import Objective, create_objective, parse_objective_string
 from .predictor import (Predictor, predict_binned_leaf, tree_scores_binned,
                         trees_scores_binned)
@@ -205,6 +206,7 @@ class GBDT:
             feat_tile=cfg.pallas_feat_tile,
             row_tile=cfg.pallas_row_tile,
             bucket_min_log2=cfg.pallas_bucket_min_log2,
+            gather_words=cfg.gather_words,
             has_categorical=bool(np.asarray(fm["is_categorical"]).any()),
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_group=cfg.max_cat_group,
@@ -1186,6 +1188,6 @@ def create_boosting(config: Config, train_set: Optional[TrainingData] = None,
 
 def _on_tpu() -> bool:
     try:
-        return any(d.platform == "tpu" for d in jax.devices())
+        return on_tpu()
     except Exception:
         return False

@@ -227,6 +227,8 @@ class Config:
     pallas_feat_tile: int = 8      # kernel grid: features per block
     pallas_row_tile: int = 512     # kernel grid: rows per block
     pallas_bucket_min_log2: int = 10   # smallest pow2 gather bucket
+    gather_words: str = "auto"     # pack bin columns into u32 words for the
+                                   # histogram row gather: auto | on | off
     # pipeline tree materialization: keep freshly grown trees on device and
     # pull them to host a few iterations late (one batched async transfer
     # per tree) so the training loop never blocks on device->host latency.
@@ -367,6 +369,9 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.pallas_bucket_min_log2 < 0 or cfg.pallas_bucket_min_log2 > 26:
         log.fatal("pallas_bucket_min_log2 must be in [0, 26]; got %d",
                   cfg.pallas_bucket_min_log2)
+    if cfg.gather_words not in ("auto", "on", "off"):
+        log.fatal("gather_words must be auto, on, or off; got %r",
+                  cfg.gather_words)
 
 
 def parse_config_file(path: str) -> Dict[str, str]:

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .data.packing import PACK_JOINT_BINS, unfold_packed_hist
-from .ops.histogram import subset_histogram
+from .ops.histogram import on_tpu, subset_histogram
 from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
                         best_split, leaf_output)
 
@@ -60,6 +60,7 @@ class GrowerConfig(NamedTuple):
     feat_tile: int = 8               # Pallas grid: features per block
     row_tile: int = 512              # Pallas grid: rows per block
     bucket_min_log2: int = 10        # smallest pow2 gather-buffer bucket
+    gather_words: str = "auto"       # word-pack bin columns for row gathers
     has_categorical: bool = False    # static: enables the categorical path
     max_cat_threshold: int = 256
     max_cat_group: int = 64
@@ -124,9 +125,62 @@ def decode_bundle_bin(raw, feat, meta: FeatureMeta):
     return jnp.where(off < 0, raw, sub)
 
 
+def pack_gather_words(mat: jnp.ndarray):
+    """[N, C] uint8/uint16 -> ([N, W] uint32, lanes_per_word).
+
+    On TPU a random row gather costs per ELEMENT, not per byte (measured
+    ~12.6 ns/elem on v5e through XLA's gather); packing 4 uint8 (or 2
+    uint16) bin columns into each uint32 word cuts the gathered element
+    count 4x (2x), and the unpack after the gather is a handful of
+    shift/mask vector ops that XLA fuses into the consumer."""
+    n, c = mat.shape
+    assert mat.dtype.itemsize <= 2, mat.dtype   # u32 words hold 4 u8 or 2 u16
+    per = 4 if mat.dtype.itemsize == 1 else 2
+    w = -(-c // per)
+    m = jnp.pad(mat, ((0, 0), (0, w * per - c))).astype(jnp.uint32)
+    m = m.reshape(n, w, per)
+    packed = m[:, :, 0]
+    for k in range(1, per):
+        packed = packed | (m[:, :, k] << (k * (32 // per)))
+    return packed, per
+
+
+def unpack_gather_words(words: jnp.ndarray, c: int, per: int) -> jnp.ndarray:
+    """[M, W] uint32 -> [M, C] int32 (inverse of :func:`pack_gather_words`)."""
+    shift = 32 // per
+    mask = jnp.uint32((1 << shift) - 1)
+    parts = [(words >> (k * shift)) & mask for k in range(per)]
+    stacked = jnp.stack(parts, axis=-1).reshape(words.shape[0], -1)
+    return stacked[:, :c].astype(jnp.int32)
+
+
+def _row_leaf_from_intervals(order, leaf_start, leaf_cnt, n):
+    """row -> leaf map recovered from the final leaf intervals of ``order``.
+
+    ``leaf_start``/``leaf_cnt`` always partition positions [0, n) into
+    disjoint per-leaf intervals, so the map is an interval lookup pushed
+    through the ``order`` permutation.  Computing it ONCE per tree here
+    replaces the per-split scatter the loop body used to do — the scatter
+    traffic drops from sum-of-window-sizes (~N*log2 L elements/tree) to a
+    single N-element pass."""
+    L = leaf_start.shape[0]
+    active = leaf_cnt > 0
+    starts = jnp.where(active, leaf_start, n)     # inactive -> spill slot n
+    leaf_ids = jnp.arange(L, dtype=jnp.int32)
+    leaf_at = jnp.zeros((n + 1,), jnp.int32).at[starts].set(leaf_ids)
+    # mark each interval head with its own position; cummax forward-fills
+    # so position p sees the start of the interval containing it (marks at
+    # non-head positions are 0, never above the true head)
+    marks = jnp.zeros((n + 1,), jnp.int32).at[starts].set(
+        jnp.where(active, leaf_start, 0))[:n]
+    head = lax.cummax(marks, axis=0)
+    leaf_of_pos = leaf_at.at[head].get(mode="promise_in_bounds")
+    return jnp.zeros((n,), jnp.int32).at[order[:n]].set(
+        leaf_of_pos, unique_indices=True, mode="promise_in_bounds")
+
+
 class _LoopState(NamedTuple):
     step: jnp.ndarray
-    row_leaf: jnp.ndarray        # [N + 1] i32: leaf id per row (+ sentinel)
     order: jnp.ndarray           # [N + maxbuf] i32: row ids grouped by leaf
     leaf_start: jnp.ndarray      # [L] i32: first position of each leaf
     leaf_cnt: jnp.ndarray        # [L] i32: local row count of each leaf
@@ -321,6 +375,14 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         hw_pad = jnp.concatenate([hw, jnp.zeros((1,), dtype)])
         cw_pad = jnp.concatenate([cw, jnp.zeros((1,), dtype)])
 
+        use_words = cfg.gather_words
+        if use_words == "auto":
+            use_words = "on" if on_tpu() else "off"
+        if hbins.dtype.itemsize > 2:
+            use_words = "off"
+        if use_words == "on":
+            hwords_pad, words_per = pack_gather_words(hbins_pad)
+
         def find(hist, pg, ph, pc, feat_ok):
             return strategy.find(ctx, hist, pg, ph, pc, feat_ok)
 
@@ -330,7 +392,12 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             moves one 256-bin histogram per packed PAIR; ``globalize``
             unfolds after the reduction (unfolding is linear, so the
             order is correctness-neutral and bandwidth-positive)."""
-            rows = jnp.take(hbins_pad, idx, axis=0)
+            if use_words == "on":
+                rows = unpack_gather_words(
+                    hwords_pad.at[idx].get(mode="promise_in_bounds"),
+                    hbins_pad.shape[1], words_per)
+            else:
+                rows = hbins_pad.at[idx].get(mode="promise_in_bounds")
             return subset_histogram(rows, gw_pad[idx], hw_pad[idx],
                                     cw_pad[idx], hist_width,
                                     method=cfg.hist_method,
@@ -365,7 +432,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             size = 1 << k
 
             def branch(args):
-                (order, row_leaf, start, cnt, new_leaf,
+                (order, start, cnt,
                  feat, thr, dleft, is_cat_l, cat_row) = args
                 win = lax.dynamic_slice(order, (start,), (size,))
                 j = jnp.arange(size, dtype=jnp.int32)
@@ -374,8 +441,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 col_idx = feat if meta.col is None else meta.col[feat]
                 # 2D gather (row, col) — per-dimension indices never
                 # overflow int32, unlike a flattened N*F index
-                binf = bins[jnp.minimum(idx, n - 1),
-                            col_idx].astype(jnp.int32)
+                binf = bins.at[jnp.minimum(idx, n - 1), col_idx].get(
+                    mode="promise_in_bounds").astype(jnp.int32)
                 if meta.col is not None:  # EFB: physical slot -> logical bin
                     binf = decode_bundle_bin(binf, feat, meta)
                 mt_f = meta.missing_type[feat]
@@ -387,22 +454,21 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 cat_go_left = cat_row[jnp.clip(binf, 0, cfg.max_bin - 1)]
                 goes_left = jnp.where(is_cat_l, cat_go_left, goes_left)
                 goes_left = goes_left & valid
-                m_right = valid & ~goes_left
                 c1 = jnp.cumsum(goes_left.astype(jnp.int32))
-                c0 = jnp.cumsum(m_right.astype(jnp.int32))
                 nl = c1[-1]
+                # right-side rank needs cumsum(valid & ~goes_left); since
+                # valid = j < cnt that cumsum is min(j+1, cnt) - c1 in
+                # closed form — one cumsum pass instead of two
+                c0 = jnp.minimum(j + 1, cnt) - c1
                 # stable two-way rank inside the window; rows past the
                 # leaf (and sentinel padding) keep their own slot so the
                 # write-back leaves neighbors untouched
                 rank = jnp.where(goes_left, c1 - 1, nl + c0 - 1)
                 rank = jnp.where(valid, rank, j)
-                new_win = jnp.zeros((size,), jnp.int32).at[rank].set(win)
+                new_win = jnp.zeros((size,), jnp.int32).at[rank].set(
+                    win, unique_indices=True)
                 order = lax.dynamic_update_slice(order, new_win, (start,))
-                # right-child rows change leaf id; sentinel writes land in
-                # the padded slot n
-                row_leaf = row_leaf.at[idx].set(
-                    jnp.where(m_right, new_leaf, row_leaf[idx]))
-                return order, row_leaf, nl
+                return order, nl
             return branch
 
         pbranches = [partition_branch(k) for k in range(kmin, kmax + 1)]
@@ -412,7 +478,6 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         root_h = strategy.reduce_scalar(jnp.sum(hw))
         root_c = strategy.reduce_scalar(jnp.sum(cw))
 
-        row_leaf = jnp.zeros((n + 1,), jnp.int32)   # + sentinel slot n
         order0 = jnp.concatenate(
             [jnp.arange(n, dtype=jnp.int32),
              jnp.full((maxbuf,), n, jnp.int32)])
@@ -481,9 +546,9 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             start = state.leaf_start[l]
             cnt = state.leaf_cnt[l]
             kp = _bucket_index(cnt, kmin, kmax)
-            order, row_leaf, nl = lax.switch(
+            order, nl = lax.switch(
                 kp, pbranches,
-                (state.order, state.row_leaf, start, cnt, new_leaf,
+                (state.order, start, cnt,
                  feat, thr, dleft, splits.is_cat[l], splits.cat_bins[l]))
             nr = cnt - nl
             leaf_start = _set(state.leaf_start, new_leaf, start + nl)
@@ -566,14 +631,16 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
 
             splits = _update_splits(splits, l, res_l)
             splits = _update_splits(splits, new_leaf, res_r)
-            return _LoopState(i + 1, row_leaf, order, leaf_start,
+            return _LoopState(i + 1, order, leaf_start,
                               leaf_cnt, hist_store, feat_ok, splits, tree)
 
-        state = _LoopState(jnp.asarray(0, jnp.int32), row_leaf, order0,
+        state = _LoopState(jnp.asarray(0, jnp.int32), order0,
                            leaf_start0, leaf_cnt0, hist_store0,
                            feat_ok_store0, splits, tree)
         state = lax.while_loop(cond, body, state)
-        return state.tree, state.row_leaf[:n]
+        row_leaf = _row_leaf_from_intervals(state.order, state.leaf_start,
+                                            state.leaf_cnt, n)
+        return state.tree, row_leaf
 
     if pack_plan is None:
         # keep the historical 6-arg signature: histogram from the same

@@ -30,6 +30,12 @@ from jax import lax
 NUM_STATS = 3     # (sum_grad, sum_hess, count)
 
 
+def on_tpu() -> bool:
+    """Whether the default jax backend is a TPU (shared platform probe —
+    hist-method and gather-words 'auto' resolution must agree)."""
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
 def _split_hi_lo(x: jnp.ndarray):
     """Split f32 into a (bf16 hi, bf16 lo) pair so a single-pass bf16 MXU
     matmul accumulates with ~f32 accuracy (hi + lo recombined after the dot).
@@ -125,9 +131,7 @@ def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     of the reference GPU learner's workgroup tuning
     (gpu_tree_learner.cpp:103-121)."""
     if method == "auto":
-        method = ("pallas"
-                  if any(d.platform == "tpu" for d in jax.devices())
-                  else "segment")
+        method = "pallas" if on_tpu() else "segment"
     if method == "pallas":
         from .pallas_hist import subset_histogram_pallas
         return subset_histogram_pallas(rows, g, h, c, num_bins,

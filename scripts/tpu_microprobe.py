@@ -99,6 +99,21 @@ def main():
     take_fn = jax.jit(lambda o: jnp.take(bins_full, o, axis=0))
     res["gather_rows_ms"] = _t(lambda: take_fn(perm), n=5) * 1e3
 
+    # 4b. gather/scatter A/B family: each candidate implementation of the
+    # grower's two hot data movements, timed head-to-head so the next
+    # optimization pass picks from measurements, not guesses
+    from lightgbm_tpu.grower import pack_gather_words, unpack_gather_words
+    words, per = pack_gather_words(bins_full)          # [N, 7] u32
+    jax.block_until_ready(words)
+    take_pib = jax.jit(lambda o: bins_full.at[o].get(mode="promise_in_bounds"))
+    res["gather_rows_pib_ms"] = _t(lambda: take_pib(perm), n=5) * 1e3
+    take_words = jax.jit(lambda o: unpack_gather_words(
+        words.at[o].get(mode="promise_in_bounds"), f, per))
+    res["gather_rows_words_ms"] = _t(lambda: take_words(perm), n=5) * 1e3
+    print(f"gather A/B: take {res['gather_rows_ms']:.1f} / pib "
+          f"{res['gather_rows_pib_ms']:.1f} / words "
+          f"{res['gather_rows_words_ms']:.1f} ms", file=sys.stderr, flush=True)
+
     def part(ord_, gl):
         c1 = jnp.cumsum(gl.astype(jnp.int32))
         c0 = jnp.cumsum((~gl).astype(jnp.int32))
@@ -107,8 +122,23 @@ def main():
         return jnp.zeros((n,), jnp.int32).at[rank].set(ord_)
     part_fn = jax.jit(part)
     res["partition_window_ms"] = _t(lambda: part_fn(order, goes_left), n=5) * 1e3
+
+    def part_opt(ord_, gl):
+        # the production form after the round-4 retune: one cumsum
+        # (closed-form valid count) + unique-indices permutation scatter
+        c1 = jnp.cumsum(gl.astype(jnp.int32))
+        nl = c1[-1]
+        j = jnp.arange(n, dtype=jnp.int32)
+        c0 = (j + 1) - c1
+        rank = jnp.where(gl, c1 - 1, nl + c0 - 1)
+        return jnp.zeros((n,), jnp.int32).at[rank].set(
+            ord_, unique_indices=True, mode="promise_in_bounds")
+    part_opt_fn = jax.jit(part_opt)
+    res["partition_window_opt_ms"] = _t(
+        lambda: part_opt_fn(order, goes_left), n=5) * 1e3
     print(f"gather {res['gather_rows_ms']:.1f} ms, partition window "
-          f"{res['partition_window_ms']:.1f} ms", file=sys.stderr, flush=True)
+          f"{res['partition_window_ms']:.1f} ms (opt "
+          f"{res['partition_window_opt_ms']:.1f})", file=sys.stderr, flush=True)
 
     # 5 + 6. the real grower and booster -------------------------------------
     from bench import make_data
@@ -141,6 +171,22 @@ def main():
     print(f"grow compile {res['grow_compile_s']:.0f} s, grow "
           f"{res['grow_ms']:.0f} ms/tree", file=sys.stderr, flush=True)
 
+    n_it = 10
+    bst.train_one_iter()            # warm the full-iteration path
+    t0 = time.perf_counter()
+    for _ in range(n_it):
+        bst.train_one_iter()
+    bst._drain_pending()
+    jax.block_until_ready(bst.scores)
+    res["train_iter_ms"] = (time.perf_counter() - t0) / n_it * 1e3
+    res["pipelined"] = bool(bst._pipeline)
+    print(f"train_one_iter {res['train_iter_ms']:.0f} ms "
+          f"(pipelined={res['pipelined']})", file=sys.stderr, flush=True)
+    print(json.dumps(res))           # flush everything banked so far: the
+    # rows sweep below recompiles the grower per size (~65 s each over the
+    # tunnel) and the tunnel has died inside it once already
+    sys.stdout.flush()
+
     # 5b. rows-sweep decomposition: grow wall ~ a + b*rows at fixed 255
     # leaves, so the intercept a / 254 splits is the per-split FIXED cost
     # (kernel-launch / small-op overhead in the while-loop body) and b the
@@ -169,18 +215,6 @@ def main():
         print(f"decomposition: per-split fixed "
               f"{res['grow_per_split_fixed_ms']:.3f} ms, per-Mrow "
               f"{res['grow_per_mrow_ms']:.0f} ms", file=sys.stderr, flush=True)
-
-    n_it = 10
-    bst.train_one_iter()            # warm the full-iteration path
-    t0 = time.perf_counter()
-    for _ in range(n_it):
-        bst.train_one_iter()
-    bst._drain_pending()
-    jax.block_until_ready(bst.scores)
-    res["train_iter_ms"] = (time.perf_counter() - t0) / n_it * 1e3
-    res["pipelined"] = bool(bst._pipeline)
-    print(f"train_one_iter {res['train_iter_ms']:.0f} ms "
-          f"(pipelined={res['pipelined']})", file=sys.stderr, flush=True)
 
     print(json.dumps(res))
 
