@@ -33,6 +33,28 @@ if ! timeout 120 python -c "import jax; print(jax.devices())" \
 fi
 
 echo "== microprobe (latency vs device time) ==" | tee -a "$OUT/log.txt"
+echo "== headline bench 1M (retuned grower) ==" | tee -a "$OUT/log.txt"
+BENCH_TREES=10 BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m.json" | tee -a "$OUT/log.txt"
+snap "headline bench"
+
+echo "== gather_words A/B (words off) ==" | tee -a "$OUT/log.txt"
+BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
+    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m_nowords.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_nowords.json" | tee -a "$OUT/log.txt"
+snap "gather_words A/B"
+
+echo "== bench 63-bin (the reference's own GPU benchmark setting) ==" \
+    | tee -a "$OUT/log.txt"
+BENCH_TREES=10 BENCH_MAX_BIN=63 BENCH_STAGE_TIMEOUT=1200 \
+    timeout 1500 python bench.py \
+    > "$OUT/bench_1m_63bin.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_63bin.json" | tee -a "$OUT/log.txt"
+snap "63-bin bench"
+
+echo "== microprobe (latency vs device time) ==" | tee -a "$OUT/log.txt"
 timeout 1800 python scripts/tpu_microprobe.py 1000000 \
     > "$OUT/microprobe.json" 2>> "$OUT/log.txt"
 cat "$OUT/microprobe.json" | tee -a "$OUT/log.txt"
