@@ -114,6 +114,31 @@ def main():
           f"{res['gather_rows_pib_ms']:.1f} / words "
           f"{res['gather_rows_words_ms']:.1f} ms", file=sys.stderr, flush=True)
 
+    # 4c. does a row scatter cost per INDEX or per ELEMENT?  If per index,
+    # the leaf-ordered-bins design (permuting [window, F] data rows with
+    # the same scatter that permutes `order`) is nearly free and deletes
+    # BOTH hot gathers; if per element it costs 28x and loses.
+    upd = jnp.asarray(rng.randint(0, 255, size=(n, f), dtype=np.uint8))
+    scat1 = jax.jit(lambda p, o: jnp.zeros((n,), jnp.int32)
+                    .at[p].set(o, unique_indices=True))
+    res["scatter_1col_ms"] = _t(lambda: scat1(perm, order), n=5) * 1e3
+    scatw = jax.jit(lambda p, u: jnp.zeros((n, f), jnp.uint8)
+                    .at[p].set(u, unique_indices=True))
+    res["scatter_wide_ms"] = _t(lambda: scatw(perm, upd), n=5) * 1e3
+    # 4d. column gather from [F, N] (transposed) vs [N, F] row-major:
+    # the partition branch reads ONE feature column at window row ids
+    bins_t = jnp.asarray(np.ascontiguousarray(np.asarray(bins_full).T))
+    colg_rm = jax.jit(lambda p: bins_full.at[p, 3].get(
+        mode="promise_in_bounds"))
+    res["gather_col_rowmajor_ms"] = _t(lambda: colg_rm(perm), n=5) * 1e3
+    colg_t = jax.jit(lambda p: bins_t.at[3, p].get(mode="promise_in_bounds"))
+    res["gather_col_transposed_ms"] = _t(lambda: colg_t(perm), n=5) * 1e3
+    print(f"scatter 1col {res['scatter_1col_ms']:.1f} / wide(28) "
+          f"{res['scatter_wide_ms']:.1f} ms; col gather rm "
+          f"{res['gather_col_rowmajor_ms']:.1f} / transposed "
+          f"{res['gather_col_transposed_ms']:.1f} ms",
+          file=sys.stderr, flush=True)
+
     def part(ord_, gl):
         c1 = jnp.cumsum(gl.astype(jnp.int32))
         c0 = jnp.cumsum((~gl).astype(jnp.int32))
