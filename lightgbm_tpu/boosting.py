@@ -207,6 +207,7 @@ class GBDT:
             row_tile=cfg.pallas_row_tile,
             bucket_min_log2=cfg.pallas_bucket_min_log2,
             gather_words=cfg.gather_words,
+            hist_impl=cfg.pallas_hist_impl,
             has_categorical=bool(np.asarray(fm["is_categorical"]).any()),
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_group=cfg.max_cat_group,

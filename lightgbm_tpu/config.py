@@ -229,6 +229,7 @@ class Config:
     pallas_bucket_min_log2: int = 10   # smallest pow2 gather bucket
     gather_words: str = "auto"     # pack bin columns into u32 words for the
                                    # histogram row gather: auto | on | off
+    pallas_hist_impl: str = "auto"  # kernel form: auto | onehot | nibble
     # pipeline tree materialization: keep freshly grown trees on device and
     # pull them to host a few iterations late (one batched async transfer
     # per tree) so the training loop never blocks on device->host latency.
@@ -372,6 +373,21 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.gather_words not in ("auto", "on", "off"):
         log.fatal("gather_words must be auto, on, or off; got %r",
                   cfg.gather_words)
+    if cfg.pallas_hist_impl not in ("auto", "onehot", "nibble"):
+        log.fatal("pallas_hist_impl must be auto, onehot, or nibble; got %r",
+                  cfg.pallas_hist_impl)
+    if cfg.pallas_hist_impl == "nibble":
+        # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
+        # axis and tiles (feat_tile * 16) output lanes — reject shapes it
+        # cannot serve here instead of a bare assert inside jit tracing
+        if cfg.max_bin <= 128:
+            log.fatal("pallas_hist_impl=nibble needs max_bin > 128 (the "
+                      "one-hot kernel already sits on the 128-lane floor "
+                      "below that); got max_bin=%d", cfg.max_bin)
+        if (cfg.pallas_feat_tile * 16) % 128 != 0:
+            log.fatal("pallas_hist_impl=nibble needs pallas_feat_tile*16 "
+                      "divisible by 128 (got pallas_feat_tile=%d)",
+                      cfg.pallas_feat_tile)
 
 
 def parse_config_file(path: str) -> Dict[str, str]:

@@ -61,6 +61,7 @@ class GrowerConfig(NamedTuple):
     row_tile: int = 512              # Pallas grid: rows per block
     bucket_min_log2: int = 10        # smallest pow2 gather-buffer bucket
     gather_words: str = "auto"       # word-pack bin columns for row gathers
+    hist_impl: str = "auto"          # pallas kernel form: onehot | nibble
     has_categorical: bool = False    # static: enables the categorical path
     max_cat_threshold: int = 256
     max_cat_group: int = 64
@@ -402,7 +403,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                                     cw_pad[idx], hist_width,
                                     method=cfg.hist_method,
                                     feat_tile=cfg.feat_tile,
-                                    row_tile=cfg.row_tile)
+                                    row_tile=cfg.row_tile,
+                                    impl=cfg.hist_impl)
 
         def globalize(hist):
             """reduce across shards, then unfold packed columns."""
@@ -490,7 +492,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             subset_histogram(hbins, gw, hw, cw, hist_width,
                              method=cfg.hist_method,
                              feat_tile=cfg.feat_tile,
-                             row_tile=cfg.row_tile))
+                             row_tile=cfg.row_tile,
+                             impl=cfg.hist_impl))
         res_root, root_feat_ok = find(hist_root, root_g, root_h, root_c,
                                       feat_ok_all)
         res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)

@@ -124,19 +124,20 @@ def subset_histogram_segment(rows: jnp.ndarray, g: jnp.ndarray,
 def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                      c: jnp.ndarray, num_bins: int,
                      method: str = "auto", feat_tile: int = 8,
-                     row_tile: int = 512) -> jnp.ndarray:
+                     row_tile: int = 512, impl: str = "auto") -> jnp.ndarray:
     """Dispatch subset histogram: rows [M, F] int, g/h/c [M] -> [F, B, 3].
 
     ``feat_tile``/``row_tile`` shape the Pallas kernel's grid — the analogue
     of the reference GPU learner's workgroup tuning
-    (gpu_tree_learner.cpp:103-121)."""
+    (gpu_tree_learner.cpp:103-121); ``impl`` picks the kernel formulation
+    (onehot | nibble | auto, see pallas_hist.hist6_pallas)."""
     if method == "auto":
         method = "pallas" if on_tpu() else "segment"
     if method == "pallas":
         from .pallas_hist import subset_histogram_pallas
         return subset_histogram_pallas(rows, g, h, c, num_bins,
                                        feat_tile=feat_tile,
-                                       row_tile=row_tile)
+                                       row_tile=row_tile, impl=impl)
     if method == "einsum":
         return subset_histogram_einsum(rows, g, h, c, num_bins)
     if method == "segment":

@@ -63,18 +63,95 @@ def _hist_kernel(bins_ref, w_ref, out_ref, *, num_bins: int, feat_tile: int):
                             preferred_element_type=jnp.float32)  # [6, TF*B]
 
 
+NIB = 16     # nibble radix: bin = hi*16 + lo, each one-hot 16 wide
+
+
+def _hist_kernel_nibble(bins_ref, w_ref, out_ref, *, feat_tile: int):
+    """Nibble-factorized histogram block: bin = hi*16 + lo.
+
+    The plain one-hot kernel's dot is [6, TR] @ [TR, TF*256]; on the MXU
+    the 6-channel M dim pads to 128, so the slot cost per row is
+    128 * 256 lanes per feature.  Factoring the one-hot through the two
+    nibbles moves the hi one-hot INTO the M dim — U = (channel x hi_onehot)
+    is 96 rows, padding 128 with only 1.3x waste — and shrinks the lane
+    side to the 16-wide lo one-hot (padded to the 128 floor): per row per
+    feature 128 * 128 slots, half the plain kernel, and ~3x less VPU work
+    building one-hots (2x16 instead of 256 compares+casts).  Only pays
+    when B_pad = 256, i.e. num_bins > 128; below that the plain kernel
+    already sits on the 128-lane floor.
+
+    Output block [96, TF*16]: rows are (ch, hi) ch-major, columns (f, lo);
+    the lane dim is exactly 128 at feat_tile=8 so no kernel-side reshape
+    ever crosses the lane boundary (the round-2 Mosaic lesson); the
+    unfold to [6, F, 256] happens outside in XLA."""
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[...].astype(jnp.int32)          # [TF, TR]
+    w = w_ref[...]                                  # [6, TR]
+    tr = bins.shape[1]
+    hi = bins >> 4                                  # [TF, TR], < 16
+    lo = bins & 15
+    for f in range(feat_tile):
+        oh_hi = (hi[f][None, :] ==
+                 lax.broadcasted_iota(jnp.int32, (NIB, tr), 0)
+                 ).astype(w.dtype)                  # [16, TR]
+        u = (w[:, None, :] * oh_hi[None, :, :]).reshape(NUM_CH * NIB, tr)
+        oh_lo = (lo[f][:, None] ==
+                 lax.broadcasted_iota(jnp.int32, (tr, NIB), 1)
+                 ).astype(w.dtype)                  # [TR, 16]
+        out_ref[:, f * NIB:(f + 1) * NIB] += jnp.dot(
+            u, oh_lo, preferred_element_type=jnp.float32)   # [96, 16]
+
+
 def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
                  feat_tile: int = 8, row_tile: int = 512,
-                 interpret: bool = False) -> jnp.ndarray:
+                 interpret: bool = False, impl: str = "auto") -> jnp.ndarray:
     """bins_t: [F, N] int; w_t: [6, N] f32 -> hist [6, F, B] f32.
 
     F must be a multiple of feat_tile and N of row_tile (pad at the caller;
     padded rows must carry w = 0, padded features are sliced off).
+
+    ``impl``: 'onehot' (single combined-index one-hot dot), 'nibble'
+    (hi/lo factorized, B_pad = 256 only), or 'auto' — which currently
+    resolves to 'onehot' unconditionally: the nibble form is the
+    projected winner at B_pad = 256 but stays opt-in until the on-chip
+    tier (test_pallas_nibble_*) proves its Mosaic lowering.
     """
     f, n = bins_t.shape
     assert f % feat_tile == 0 and n % row_tile == 0, (f, n, feat_tile, row_tile)
     b_pad = -(-num_bins // LANES) * LANES
     grid = (f // feat_tile, n // row_tile)
+    if impl == "auto":
+        # the nibble form is the projected winner at B_pad = 256, but it
+        # has not yet compiled under Mosaic on a real chip (the round-2
+        # lesson: interpret mode cannot see lowering failures) — 'auto'
+        # stays on the hardware-proven kernel until the on-chip tier
+        # passes test_pallas_nibble_* (then flip here)
+        impl = "onehot"
+    if impl == "nibble":
+        assert b_pad == 2 * LANES and (feat_tile * NIB) % LANES == 0, \
+            (num_bins, feat_tile)
+        out2d = pl.pallas_call(
+            functools.partial(_hist_kernel_nibble, feat_tile=feat_tile),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((feat_tile, row_tile), lambda fi, ri: (fi, ri)),
+                pl.BlockSpec((NUM_CH, row_tile), lambda fi, ri: (0, ri)),
+            ],
+            out_specs=pl.BlockSpec((NUM_CH * NIB, feat_tile * NIB),
+                                   lambda fi, ri: (0, fi)),
+            out_shape=jax.ShapeDtypeStruct((NUM_CH * NIB, f * NIB),
+                                           jnp.float32),
+            interpret=interpret,
+        )(bins_t, w_t)
+        # [(ch, hi), (f, lo)] -> [ch, f, hi*16+lo], all in XLA
+        out4 = out2d.reshape(NUM_CH, NIB, f, NIB)
+        return out4.transpose(0, 2, 1, 3).reshape(
+            NUM_CH, f, NIB * NIB)[:, :, :num_bins]
     out2d = pl.pallas_call(
         functools.partial(_hist_kernel, num_bins=b_pad,
                           feat_tile=feat_tile),
@@ -95,7 +172,8 @@ def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
 def subset_histogram_pallas(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                             c: jnp.ndarray, num_bins: int,
                             feat_tile: int = 8, row_tile: int = 512,
-                            interpret: bool = False) -> jnp.ndarray:
+                            interpret: bool = False,
+                            impl: str = "auto") -> jnp.ndarray:
     """Histogram of a gathered row subset: rows [M, F] int, g/h/c [M] f32
     (0 for padding rows) -> [F, B, 3].
 
@@ -118,7 +196,7 @@ def subset_histogram_pallas(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         bins_t = jnp.pad(bins_t, ((0, 0), (0, pad_m)))
         w_t = jnp.pad(w_t, ((0, 0), (0, pad_m)))
     hist6 = hist6_pallas(bins_t, w_t, num_bins, feat_tile, row_tile,
-                         interpret=interpret)[:, :f]             # [6, F, B]
+                         interpret=interpret, impl=impl)[:, :f]  # [6, F, B]
     hist_g = hist6[0] + hist6[1]
     hist_h = hist6[2] + hist6[3]
     return jnp.stack([hist_g, hist_h, hist6[4]], axis=-1)        # [F, B, 3]

@@ -27,11 +27,40 @@ def main():
         alias_map[canon].append(a)
 
     cfg_src = os.path.join(os.path.dirname(C.__file__), "config.py")
+    lines = open(cfg_src).readlines()
+    field_re = re.compile(r'\s*(\w+):\s*[\w\[\]\., "\'=]+?(?:#\s*(.+))?$')
+    comment_re = re.compile(r"\s*#\s*(.+)$")
     comments = {}
-    for line in open(cfg_src):
-        m = re.match(r'\s*(\w+):\s*[\w\[\]\., "\'=]+#\s*(.+)$', line)
-        if m:
-            comments[m.group(1)] = m.group(2).strip()
+    i = 0
+    while i < len(lines):
+        m = field_re.match(lines[i].rstrip())
+        if not (m and ":" in lines[i]):
+            i += 1
+            continue
+        field, inline = m.group(1), (m.group(2) or "").strip()
+        # gather the standalone-comment block that follows the declaration
+        j = i + 1
+        block = []
+        while j < len(lines):
+            mc = comment_re.match(lines[j])
+            if not mc:
+                break
+            block.append(mc.group(1).strip())
+            j += 1
+        # the block continues THIS field unless it introduces the next
+        # field (next line declares a field with no inline comment of its
+        # own — then the block is that field's leading description)
+        nxt = field_re.match(lines[j].rstrip()) if j < len(lines) else None
+        if nxt and ":" in (lines[j] if j < len(lines) else "") \
+                and not (nxt.group(2) or "").strip() and block:
+            if inline:
+                comments[field] = inline
+            comments[nxt.group(1)] = " ".join(block)
+        else:
+            val = " ".join([inline] + block).strip()
+            if val:                      # never clobber a leading-block
+                comments[field] = val    # description with an empty one
+        i = j
 
     out_path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "Parameters.md")

@@ -46,6 +46,29 @@ BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
 cat "$OUT/bench_1m_nowords.json" | tee -a "$OUT/log.txt"
 snap "gather_words A/B"
 
+echo "== on-chip tier (incl. nibble-kernel Mosaic gate) ==" \
+    | tee -a "$OUT/log.txt"
+LGBM_TPU_TESTS_ON_TPU=1 timeout 1500 python -m pytest tests/test_tpu.py \
+    -q >> "$OUT/log.txt" 2>&1
+tail -6 "$OUT/log.txt"
+snap "on-chip tier"
+
+echo "== nibble kernel A/B bench ==" | tee -a "$OUT/log.txt"
+# only worth a bench slot if the Mosaic gate just passed (a failed gate
+# means the same compile error would burn this stage's whole timeout)
+if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
+        "tests/test_tpu.py::test_pallas_nibble_compiles_on_tpu" \
+        -q >> "$OUT/log.txt" 2>&1; then
+    BENCH_TREES=6 BENCH_EXTRA_PARAMS=pallas_hist_impl=nibble \
+        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+        > "$OUT/bench_1m_nibble.json" 2>> "$OUT/log.txt"
+    cat "$OUT/bench_1m_nibble.json" | tee -a "$OUT/log.txt"
+    snap "nibble A/B"
+else
+    echo "nibble Mosaic gate FAILED - skipping nibble bench" \
+        | tee -a "$OUT/log.txt"
+fi
+
 echo "== bench 63-bin (the reference's own GPU benchmark setting) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=10 BENCH_MAX_BIN=63 BENCH_STAGE_TIMEOUT=1200 \

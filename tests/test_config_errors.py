@@ -21,6 +21,10 @@ from lightgbm_tpu.config import config_from_params
     ({"max_bin": 100000}, "max_bin"),
     ({"pallas_row_tile": 100}, "multiple of 128"),
     ({"pallas_feat_tile": -1}, "positive"),
+    ({"gather_words": "maybe"}, "gather_words"),
+    ({"pallas_hist_impl": "fancy"}, "pallas_hist_impl"),
+    ({"pallas_hist_impl": "nibble", "max_bin": 63}, "max_bin > 128"),
+    ({"pallas_hist_impl": "nibble", "pallas_feat_tile": 4}, "divisible"),
     ({"metric": "made_up_metric", "objective": "binary"}, "metric"),
 ])
 def test_bad_params_rejected(params, msg):

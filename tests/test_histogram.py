@@ -93,3 +93,40 @@ def test_pallas_odd_sizes_interpret():
         jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
         b, feat_tile=4, row_tile=512, interpret=True))
     np.testing.assert_allclose(p, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_pallas_nibble_matches_einsum_interpret():
+    """The hi/lo nibble-factorized kernel (B_pad = 256) must agree with the
+    f32 einsum oracle bin for bin, counts exactly."""
+    rng = np.random.RandomState(4)
+    m, f, b = 2048, 16, 255
+    real = 1500
+    rows = rng.randint(0, b, size=(m, f)).astype(np.uint8)
+    g = rng.randn(m).astype(np.float32)
+    h = np.abs(rng.randn(m)).astype(np.float32)
+    c = (rng.rand(m) > 0.1).astype(np.float32)
+    g[real:] = 0.0
+    h[real:] = 0.0
+    c[real:] = 0.0
+    a = np.asarray(subset_histogram_einsum(
+        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c), b))
+    p = np.asarray(subset_histogram_pallas(
+        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+        b, feat_tile=8, row_tile=512, interpret=True, impl="nibble"))
+    np.testing.assert_allclose(p, a, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(p[:, :, 2], a[:, :, 2])
+
+
+def test_pallas_nibble_full_256_bins():
+    """num_bins = 256 exactly (no phantom-bin slice) through the nibble path."""
+    rng = np.random.RandomState(5)
+    m, f, b = 1024, 8, 256
+    rows = rng.randint(0, b, size=(m, f)).astype(np.uint8)
+    g = rng.randn(m).astype(np.float32)
+    h = np.ones(m, np.float32)
+    c = np.ones(m, np.float32)
+    ref = _numpy_reference(rows, g, h, c, b)
+    p = np.asarray(subset_histogram_pallas(
+        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+        b, feat_tile=8, row_tile=512, interpret=True, impl="nibble"))
+    np.testing.assert_allclose(p, ref, rtol=3e-4, atol=3e-4)
