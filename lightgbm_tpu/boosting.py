@@ -208,6 +208,8 @@ class GBDT:
             bucket_min_log2=cfg.pallas_bucket_min_log2,
             gather_words=cfg.gather_words,
             hist_impl=cfg.pallas_hist_impl,
+            ordered_bins=("off" if cfg.ordered_bins == "auto"
+                          else cfg.ordered_bins),
             has_categorical=bool(np.asarray(fm["is_categorical"]).any()),
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_group=cfg.max_cat_group,
@@ -282,6 +284,12 @@ class GBDT:
                               for i in train.used_features])
             self._pack_plan = build_pack_plan(col_bins)
             if self._pack_plan is not None:
+                if cfg.ordered_bins == "on":
+                    log.warning("ordered_bins=on is ignored while nibble "
+                                "bin packing is active (the packed storage "
+                                "matrix has its own layout); set "
+                                "enable_bin_packing=false to use the "
+                                "leaf-ordered path")
                 self._hist_bins = pack_columns(np.asarray(train.binned),
                                                self._pack_plan)
                 log.info("Bin packing: %d of %d columns nibble-packed "

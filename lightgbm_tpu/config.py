@@ -230,6 +230,10 @@ class Config:
     gather_words: str = "auto"     # pack bin columns into u32 words for the
                                    # histogram row gather: auto | on | off
     pallas_hist_impl: str = "auto"  # kernel form: auto | onehot | nibble
+    ordered_bins: str = "auto"     # leaf-ordered bin matrix (OrderedBin
+                                   # analogue): auto | on | off; 'on' trades
+                                   # wide partition scatters for contiguous
+                                   # histogram reads (no row gathers)
     # pipeline tree materialization: keep freshly grown trees on device and
     # pull them to host a few iterations late (one batched async transfer
     # per tree) so the training loop never blocks on device->host latency.
@@ -376,6 +380,9 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.pallas_hist_impl not in ("auto", "onehot", "nibble"):
         log.fatal("pallas_hist_impl must be auto, onehot, or nibble; got %r",
                   cfg.pallas_hist_impl)
+    if cfg.ordered_bins not in ("auto", "on", "off"):
+        log.fatal("ordered_bins must be auto, on, or off; got %r",
+                  cfg.ordered_bins)
     if cfg.pallas_hist_impl == "nibble":
         # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
         # axis and tiles (feat_tile * 16) output lanes — reject shapes it

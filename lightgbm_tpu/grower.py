@@ -62,6 +62,7 @@ class GrowerConfig(NamedTuple):
     bucket_min_log2: int = 10        # smallest pow2 gather-buffer bucket
     gather_words: str = "auto"       # word-pack bin columns for row gathers
     hist_impl: str = "auto"          # pallas kernel form: onehot | nibble
+    ordered_bins: str = "off"        # leaf-ordered bin matrix: on | off
     has_categorical: bool = False    # static: enables the categorical path
     max_cat_threshold: int = 256
     max_cat_group: int = 64
@@ -183,6 +184,9 @@ def _row_leaf_from_intervals(order, leaf_start, leaf_cnt, n):
 class _LoopState(NamedTuple):
     step: jnp.ndarray
     order: jnp.ndarray           # [N + maxbuf] i32: row ids grouped by leaf
+    obins: jnp.ndarray           # [N + maxbuf, C] leaf-ordered bin matrix
+    ow: jnp.ndarray              # [N + maxbuf, 3] leaf-ordered (g, h, c)
+    #                              (both [0, 0] dummies unless ordered_bins)
     leaf_start: jnp.ndarray      # [L] i32: first position of each leaf
     leaf_cnt: jnp.ndarray        # [L] i32: local row count of each leaf
     hist_store: jnp.ndarray      # [L, F, B, 3]: per-leaf histograms
@@ -381,11 +385,32 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             use_words = "on" if on_tpu() else "off"
         if hbins.dtype.itemsize > 2:
             use_words = "off"
+        # leaf-ordered mode (OrderedSparseBin analogue,
+        # src/io/ordered_sparse_bin.hpp): a physically leaf-ordered copy of
+        # the histogram matrix (+ weights) rides along with ``order`` — the
+        # partition permutes its windows too, so every smaller-child
+        # histogram reads a CONTIGUOUS slice instead of a random row
+        # gather.  Profitable iff the wide-update scatter costs per index
+        # rather than per element (microprobe scatter_wide_ms); the window
+        # presents rows in exactly the gather's sequence, so trees are
+        # bit-identical either way.
+        use_ordered = cfg.ordered_bins == "on" and pack_plan is None
+        route_from_obins = (use_ordered and hbins is hist_src
+                            and hist_src is bins)
+        if use_ordered:
+            use_words = "off"         # nothing left to gather
         if use_words == "on":
             hwords_pad, words_per = pack_gather_words(hbins_pad)
 
         def find(hist, pg, ph, pc, feat_ok):
             return strategy.find(ctx, hist, pg, ph, pc, feat_ok)
+
+        def hist_subset(rows, g_, h_, c_):
+            return subset_histogram(rows, g_, h_, c_, hist_width,
+                                    method=cfg.hist_method,
+                                    feat_tile=cfg.feat_tile,
+                                    row_tile=cfg.row_tile,
+                                    impl=cfg.hist_impl)
 
         def measure(idx):
             """RAW histogram of rows ``idx`` (sentinel-padded): packed
@@ -399,12 +424,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                     hbins_pad.shape[1], words_per)
             else:
                 rows = hbins_pad.at[idx].get(mode="promise_in_bounds")
-            return subset_histogram(rows, gw_pad[idx], hw_pad[idx],
-                                    cw_pad[idx], hist_width,
-                                    method=cfg.hist_method,
-                                    feat_tile=cfg.feat_tile,
-                                    row_tile=cfg.row_tile,
-                                    impl=cfg.hist_impl)
+            return hist_subset(rows, gw_pad[idx], hw_pad[idx], cw_pad[idx])
 
         def globalize(hist):
             """reduce across shards, then unfold packed columns."""
@@ -415,7 +435,15 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
 
         def bucket_branch(k):
             def branch(args):
-                order, sstart, scnt = args
+                order, obins, ow, sstart, scnt = args
+                if use_ordered:
+                    wb = lax.dynamic_slice(
+                        obins, (sstart, 0), (1 << k, obins.shape[1]))
+                    wwt = lax.dynamic_slice(ow, (sstart, 0), (1 << k, 3))
+                    mask = (jnp.arange(1 << k, dtype=jnp.int32)
+                            < scnt).astype(wwt.dtype)
+                    return hist_subset(wb, wwt[:, 0] * mask,
+                                       wwt[:, 1] * mask, wwt[:, 2] * mask)
                 idx = lax.dynamic_slice(order, (sstart,), (1 << k,))
                 valid = jnp.arange(1 << k, dtype=jnp.int32) < scnt
                 return measure(jnp.where(valid, idx, n))
@@ -434,17 +462,25 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             size = 1 << k
 
             def branch(args):
-                (order, start, cnt,
+                (order, obins, ow, start, cnt,
                  feat, thr, dleft, is_cat_l, cat_row) = args
                 win = lax.dynamic_slice(order, (start,), (size,))
                 j = jnp.arange(size, dtype=jnp.int32)
                 valid = j < cnt
                 idx = jnp.where(valid, win, n)
                 col_idx = feat if meta.col is None else meta.col[feat]
-                # 2D gather (row, col) — per-dimension indices never
-                # overflow int32, unlike a flattened N*F index
-                binf = bins.at[jnp.minimum(idx, n - 1), col_idx].get(
-                    mode="promise_in_bounds").astype(jnp.int32)
+                if route_from_obins:
+                    # the splitting column is a strided (not random) read
+                    # of the ordered window — no gather at all
+                    wb = lax.dynamic_slice(
+                        obins, (start, 0), (size, obins.shape[1]))
+                    binf = lax.dynamic_index_in_dim(
+                        wb, col_idx, axis=1, keepdims=False).astype(jnp.int32)
+                else:
+                    # 2D gather (row, col) — per-dimension indices never
+                    # overflow int32, unlike a flattened N*F index
+                    binf = bins.at[jnp.minimum(idx, n - 1), col_idx].get(
+                        mode="promise_in_bounds").astype(jnp.int32)
                 if meta.col is not None:  # EFB: physical slot -> logical bin
                     binf = decode_bundle_bin(binf, feat, meta)
                 mt_f = meta.missing_type[feat]
@@ -470,7 +506,19 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 new_win = jnp.zeros((size,), jnp.int32).at[rank].set(
                     win, unique_indices=True)
                 order = lax.dynamic_update_slice(order, new_win, (start,))
-                return order, nl
+                if use_ordered:
+                    # permute the ordered data windows with the same ranks
+                    if not route_from_obins:
+                        wb = lax.dynamic_slice(
+                            obins, (start, 0), (size, obins.shape[1]))
+                    wwt = lax.dynamic_slice(ow, (start, 0), (size, 3))
+                    new_wb = jnp.zeros_like(wb).at[rank].set(
+                        wb, unique_indices=True)
+                    new_wt = jnp.zeros_like(wwt).at[rank].set(
+                        wwt, unique_indices=True)
+                    obins = lax.dynamic_update_slice(obins, new_wb, (start, 0))
+                    ow = lax.dynamic_update_slice(ow, new_wt, (start, 0))
+                return order, obins, ow, nl
             return branch
 
         pbranches = [partition_branch(k) for k in range(kmin, kmax + 1)]
@@ -483,17 +531,24 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         order0 = jnp.concatenate(
             [jnp.arange(n, dtype=jnp.int32),
              jnp.full((maxbuf,), n, jnp.int32)])
+        if use_ordered:
+            # rows start in natural order (order0 = iota), so the ordered
+            # copies ARE the inputs; maxbuf tail rows never contribute
+            # (bucket masks zero their weights)
+            obins0 = jnp.concatenate(
+                [hbins, jnp.zeros((maxbuf, hbins.shape[1]), hbins.dtype)])
+            ow0 = jnp.concatenate(
+                [jnp.stack([gw, hw, cw], axis=1),
+                 jnp.zeros((maxbuf, 3), dtype)])
+        else:
+            obins0 = jnp.zeros((0, 0), hbins.dtype)
+            ow0 = jnp.zeros((0, 0), dtype)
         leaf_start0 = jnp.zeros((L,), jnp.int32)
         leaf_cnt0 = _set(jnp.zeros((L,), jnp.int32), 0, n)
 
         num_logical = meta.num_bin.shape[0]
         feat_ok_all = jnp.ones((num_logical,), bool)
-        hist_root = globalize(
-            subset_histogram(hbins, gw, hw, cw, hist_width,
-                             method=cfg.hist_method,
-                             feat_tile=cfg.feat_tile,
-                             row_tile=cfg.row_tile,
-                             impl=cfg.hist_impl))
+        hist_root = globalize(hist_subset(hbins, gw, hw, cw))
         res_root, root_feat_ok = find(hist_root, root_g, root_h, root_c,
                                       feat_ok_all)
         res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
@@ -549,9 +604,9 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             start = state.leaf_start[l]
             cnt = state.leaf_cnt[l]
             kp = _bucket_index(cnt, kmin, kmax)
-            order, nl = lax.switch(
+            order, obins, ow, nl = lax.switch(
                 kp, pbranches,
-                (state.order, start, cnt,
+                (state.order, state.obins, state.ow, start, cnt,
                  feat, thr, dleft, splits.is_cat[l], splits.cat_bins[l]))
             nr = cnt - nl
             leaf_start = _set(state.leaf_start, new_leaf, start + nl)
@@ -603,7 +658,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             sstart = jnp.where(small_left, start, start + nl)
             scnt = jnp.where(small_left, nl, nr)   # LOCAL count of that child
             ki = _bucket_index(scnt, kmin, kmax)
-            hist_small = lax.switch(ki, branches, (order, sstart, scnt))
+            hist_small = lax.switch(ki, branches,
+                                    (order, obins, ow, sstart, scnt))
             hist_small = globalize(hist_small)
             hist_parent = lax.dynamic_index_in_dim(state.hist_store, l, axis=0,
                                                    keepdims=False)
@@ -634,10 +690,10 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
 
             splits = _update_splits(splits, l, res_l)
             splits = _update_splits(splits, new_leaf, res_r)
-            return _LoopState(i + 1, order, leaf_start,
+            return _LoopState(i + 1, order, obins, ow, leaf_start,
                               leaf_cnt, hist_store, feat_ok, splits, tree)
 
-        state = _LoopState(jnp.asarray(0, jnp.int32), order0,
+        state = _LoopState(jnp.asarray(0, jnp.int32), order0, obins0, ow0,
                            leaf_start0, leaf_cnt0, hist_store0,
                            feat_ok_store0, splits, tree)
         state = lax.while_loop(cond, body, state)

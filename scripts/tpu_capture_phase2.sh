@@ -46,6 +46,14 @@ BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
 cat "$OUT/bench_1m_nowords.json" | tee -a "$OUT/log.txt"
 snap "gather_words A/B"
 
+echo "== ordered_bins A/B (leaf-ordered matrix vs gather) ==" \
+    | tee -a "$OUT/log.txt"
+BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on \
+    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m_ordered.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_ordered.json" | tee -a "$OUT/log.txt"
+snap "ordered_bins A/B"
+
 echo "== on-chip tier (incl. nibble-kernel Mosaic gate) ==" \
     | tee -a "$OUT/log.txt"
 LGBM_TPU_TESTS_ON_TPU=1 timeout 1500 python -m pytest tests/test_tpu.py \
