@@ -234,18 +234,19 @@ class Config:
                                    # analogue): auto | on | off; 'on' trades
                                    # wide partition scatters for contiguous
                                    # histogram reads (no row gathers)
-    # pipeline tree materialization: keep freshly grown trees on device and
-    # pull them to host a few iterations late (one batched async transfer
-    # per tree) so the training loop never blocks on device->host latency.
-    # Matters enormously when the accelerator sits behind a high-latency
-    # link; synchronous fallback happens automatically for DART/RF,
-    # multi-process meshes, and custom-gradient training.  The final model
-    # is always bit-identical to the synchronous path; the one observable
-    # difference is that a mid-run "no more leaves" stop is DETECTED up to
-    # a few iterations late, so per-iteration callbacks may see evals for
-    # iterations that are then rewound (tests/test_pipeline.py pins the
-    # rewind to the exact synchronous final state).
-    pipeline_trees: bool = True
+
+    pipeline_trees: bool = True    # pipeline tree materialization: keep
+    # freshly grown trees on device and pull them to host a few iterations
+    # late (one batched async transfer per tree) so the training loop never
+    # blocks on device->host latency.  Matters enormously when the
+    # accelerator sits behind a high-latency link; synchronous fallback
+    # happens automatically for DART/RF, multi-process meshes, and
+    # custom-gradient training.  The final model is always bit-identical to
+    # the synchronous path; the one observable difference is that a mid-run
+    # "no more leaves" stop is DETECTED up to a few iterations late, so
+    # per-iteration callbacks may see evals for iterations that are then
+    # rewound (tests/test_pipeline.py pins the rewind to the exact
+    # synchronous final state).
 
     # file-task fields (CLI)
     data: str = ""
