@@ -148,6 +148,24 @@ def main():
     part_fn = jax.jit(part)
     res["partition_window_ms"] = _t(lambda: part_fn(order, goes_left), n=5) * 1e3
 
+    # 4e. sort-as-partition: a stable sort on the 1-bit goes_left key with
+    # the window as payload IS the stable partition, and XLA:TPU's sort
+    # network does only vectorized sequential memory passes — no random
+    # HBM access at all.  If this beats the rank scatter, the partition
+    # leaves the per-element-random cost class entirely (and can carry
+    # the ordered-mode data words as extra payload operands).
+    from jax import lax
+
+    def part_sort(ord_, gl):
+        keys = (~gl).astype(jnp.int32)
+        _, out = lax.sort((keys, ord_), is_stable=True, num_keys=1)
+        return out
+    part_sort_fn = jax.jit(part_sort)
+    res["partition_sort_ms"] = _t(
+        lambda: part_sort_fn(order, goes_left), n=5) * 1e3
+    print(f"partition via stable sort {res['partition_sort_ms']:.1f} ms",
+          file=sys.stderr, flush=True)
+
     def part_opt(ord_, gl):
         # the production form after the round-4 retune: one cumsum
         # (closed-form valid count) + unique-indices permutation scatter
