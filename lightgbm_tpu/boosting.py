@@ -210,6 +210,8 @@ class GBDT:
             hist_impl=cfg.pallas_hist_impl,
             ordered_bins=("off" if cfg.ordered_bins == "auto"
                           else cfg.ordered_bins),
+            partition_impl=("scatter" if cfg.partition_impl == "auto"
+                            else cfg.partition_impl),
             has_categorical=bool(np.asarray(fm["is_categorical"]).any()),
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_group=cfg.max_cat_group,

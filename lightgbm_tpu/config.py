@@ -234,6 +234,9 @@ class Config:
                                    # analogue): auto | on | off; 'on' trades
                                    # wide partition scatters for contiguous
                                    # histogram reads (no row gathers)
+    partition_impl: str = "auto"   # window partition: auto | scatter | sort
+                                   # (sort = stable 1-bit-key payload sort,
+                                   # no random HBM access)
 
     pipeline_trees: bool = True    # pipeline tree materialization: keep
     # freshly grown trees on device and pull them to host a few iterations
@@ -384,6 +387,13 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.ordered_bins not in ("auto", "on", "off"):
         log.fatal("ordered_bins must be auto, on, or off; got %r",
                   cfg.ordered_bins)
+    if cfg.partition_impl not in ("auto", "scatter", "sort"):
+        log.fatal("partition_impl must be auto, scatter, or sort; got %r",
+                  cfg.partition_impl)
+    if cfg.partition_impl == "sort" and cfg.ordered_bins == "on":
+        log.warning("partition_impl=sort does not yet carry the "
+                    "leaf-ordered data payloads; ordered_bins=on falls "
+                    "back to the rank-scatter partition")
     if cfg.pallas_hist_impl == "nibble":
         # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
         # axis and tiles (feat_tile * 16) output lanes — reject shapes it

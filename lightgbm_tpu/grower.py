@@ -63,6 +63,7 @@ class GrowerConfig(NamedTuple):
     gather_words: str = "auto"       # word-pack bin columns for row gathers
     hist_impl: str = "auto"          # pallas kernel form: onehot | nibble
     ordered_bins: str = "off"        # leaf-ordered bin matrix: on | off
+    partition_impl: str = "scatter"  # window partition: scatter | sort
     has_categorical: bool = False    # static: enables the categorical path
     max_cat_threshold: int = 256
     max_cat_group: int = 64
@@ -492,19 +493,34 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 cat_go_left = cat_row[jnp.clip(binf, 0, cfg.max_bin - 1)]
                 goes_left = jnp.where(is_cat_l, cat_go_left, goes_left)
                 goes_left = goes_left & valid
-                c1 = jnp.cumsum(goes_left.astype(jnp.int32))
-                nl = c1[-1]
-                # right-side rank needs cumsum(valid & ~goes_left); since
-                # valid = j < cnt that cumsum is min(j+1, cnt) - c1 in
-                # closed form — one cumsum pass instead of two
-                c0 = jnp.minimum(j + 1, cnt) - c1
-                # stable two-way rank inside the window; rows past the
-                # leaf (and sentinel padding) keep their own slot so the
-                # write-back leaves neighbors untouched
-                rank = jnp.where(goes_left, c1 - 1, nl + c0 - 1)
-                rank = jnp.where(valid, rank, j)
-                new_win = jnp.zeros((size,), jnp.int32).at[rank].set(
-                    win, unique_indices=True)
+                use_sort = cfg.partition_impl == "sort" and not use_ordered
+                if use_sort:
+                    # stable 3-way key sort: lefts (0) then rights (1);
+                    # past-the-leaf slots (2) are already contiguous at
+                    # the window tail in original order, so a stable sort
+                    # returns them exactly where they started.  XLA:TPU's
+                    # sort network is all vectorized sequential passes —
+                    # no random HBM access, unlike the rank scatter.
+                    nl = jnp.sum(goes_left.astype(jnp.int32))
+                    key = jnp.where(~valid, 2,
+                                    jnp.where(goes_left, 0, 1))
+                    _, new_win = lax.sort((key.astype(jnp.int32), win),
+                                          is_stable=True, num_keys=1)
+                else:
+                    c1 = jnp.cumsum(goes_left.astype(jnp.int32))
+                    nl = c1[-1]
+                    # right-side rank needs cumsum(valid & ~goes_left);
+                    # since valid = j < cnt that cumsum is
+                    # min(j+1, cnt) - c1 in closed form — one cumsum pass
+                    # instead of two
+                    c0 = jnp.minimum(j + 1, cnt) - c1
+                    # stable two-way rank inside the window; rows past the
+                    # leaf (and sentinel padding) keep their own slot so
+                    # the write-back leaves neighbors untouched
+                    rank = jnp.where(goes_left, c1 - 1, nl + c0 - 1)
+                    rank = jnp.where(valid, rank, j)
+                    new_win = jnp.zeros((size,), jnp.int32).at[rank].set(
+                        win, unique_indices=True)
                 order = lax.dynamic_update_slice(order, new_win, (start,))
                 if use_ordered:
                     # permute the ordered data windows with the same ranks

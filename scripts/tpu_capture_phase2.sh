@@ -46,6 +46,14 @@ BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
 cat "$OUT/bench_1m_nowords.json" | tee -a "$OUT/log.txt"
 snap "gather_words A/B"
 
+echo "== partition_impl=sort A/B (payload sort vs rank scatter) ==" \
+    | tee -a "$OUT/log.txt"
+BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=sort \
+    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m_sortpart.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_sortpart.json" | tee -a "$OUT/log.txt"
+snap "sort-partition A/B"
+
 echo "== ordered_bins A/B (leaf-ordered matrix vs gather) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on \

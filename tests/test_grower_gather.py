@@ -111,3 +111,30 @@ def test_grow_ordered_bins_identical_efb_end_to_end():
         bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
         texts[mode] = bst.model_to_string()
     assert texts["off"] == texts["on"]
+
+
+def test_grow_partition_sort_identical():
+    """partition_impl=sort (stable 3-way-key payload sort) must reproduce
+    the rank-scatter partition bit for bit, including past-the-leaf window
+    slots returning to their original positions."""
+    rng = np.random.RandomState(9)
+    n, f, b = 6000, 9, 47
+    bins = jnp.asarray(rng.randint(0, b, size=(n, f), dtype=np.uint8))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.asarray(np.ones(n, np.float32))
+    c = jnp.asarray(np.ones(n, np.float32))
+    meta = FeatureMeta(num_bin=jnp.full((f,), b, jnp.int32),
+                       missing_type=jnp.zeros((f,), jnp.int32),
+                       default_bin=jnp.zeros((f,), jnp.int32),
+                       is_categorical=jnp.zeros((f,), bool))
+    fv = jnp.ones((f,), bool)
+    outs = {}
+    for impl in ("scatter", "sort"):
+        cfg = GrowerConfig(num_leaves=31, min_data_in_leaf=1, max_bin=b,
+                           hist_method="segment", bucket_min_log2=6,
+                           partition_impl=impl)
+        tree, row_leaf = jax.jit(make_grower(cfg))(bins, g, h, c, meta, fv)
+        outs[impl] = jax.tree.map(np.asarray, (tree, row_leaf))
+    for a, bb in zip(outs["scatter"][0], outs["sort"][0]):
+        assert np.array_equal(a, bb)
+    assert np.array_equal(outs["scatter"][1], outs["sort"][1])
