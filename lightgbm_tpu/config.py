@@ -390,10 +390,6 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.partition_impl not in ("auto", "scatter", "sort"):
         log.fatal("partition_impl must be auto, scatter, or sort; got %r",
                   cfg.partition_impl)
-    if cfg.partition_impl == "sort" and cfg.ordered_bins == "on":
-        log.warning("partition_impl=sort does not yet carry the "
-                    "leaf-ordered data payloads; ordered_bins=on falls "
-                    "back to the rank-scatter partition")
     if cfg.pallas_hist_impl == "nibble":
         # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
         # axis and tiles (feat_tile * 16) output lanes — reject shapes it

@@ -493,19 +493,49 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 cat_go_left = cat_row[jnp.clip(binf, 0, cfg.max_bin - 1)]
                 goes_left = jnp.where(is_cat_l, cat_go_left, goes_left)
                 goes_left = goes_left & valid
-                use_sort = cfg.partition_impl == "sort" and not use_ordered
+                use_sort = cfg.partition_impl == "sort"
                 if use_sort:
                     # stable 3-way key sort: lefts (0) then rights (1);
                     # past-the-leaf slots (2) are already contiguous at
                     # the window tail in original order, so a stable sort
                     # returns them exactly where they started.  XLA:TPU's
                     # sort network is all vectorized sequential passes —
-                    # no random HBM access, unlike the rank scatter.
+                    # no random HBM access, unlike the rank scatter.  In
+                    # ordered mode the leaf-ordered data rides through the
+                    # same sort as extra payload operands (bin columns
+                    # packed into u32 words, weights bitcast to u32).
                     nl = jnp.sum(goes_left.astype(jnp.int32))
                     key = jnp.where(~valid, 2,
-                                    jnp.where(goes_left, 0, 1))
-                    _, new_win = lax.sort((key.astype(jnp.int32), win),
-                                          is_stable=True, num_keys=1)
+                                    jnp.where(goes_left, 0, 1)
+                                    ).astype(jnp.int32)
+                    if use_ordered:
+                        if not route_from_obins:
+                            wb = lax.dynamic_slice(
+                                obins, (start, 0), (size, obins.shape[1]))
+                        wwt = lax.dynamic_slice(ow, (start, 0), (size, 3))
+                        if wb.dtype.itemsize <= 2:
+                            wbw, wper = pack_gather_words(wb)
+                        else:          # rare wide dtype: raw columns
+                            wbw, wper = wb, None
+                        wtw = lax.bitcast_convert_type(wwt, jnp.uint32)
+                        ops = (key, win,
+                               *(wbw[:, kk] for kk in range(wbw.shape[1])),
+                               *(wtw[:, kk] for kk in range(3)))
+                        out = lax.sort(ops, is_stable=True, num_keys=1)
+                        new_win = out[1]
+                        nw = wbw.shape[1]
+                        sorted_wbw = jnp.stack(out[2:2 + nw], axis=1)
+                        new_wb = (unpack_gather_words(
+                            sorted_wbw, wb.shape[1], wper).astype(wb.dtype)
+                            if wper is not None else sorted_wbw)
+                        new_wt = lax.bitcast_convert_type(
+                            jnp.stack(out[2 + nw:], axis=1), jnp.float32)
+                        obins = lax.dynamic_update_slice(
+                            obins, new_wb, (start, 0))
+                        ow = lax.dynamic_update_slice(ow, new_wt, (start, 0))
+                    else:
+                        _, new_win = lax.sort((key, win),
+                                              is_stable=True, num_keys=1)
                 else:
                     c1 = jnp.cumsum(goes_left.astype(jnp.int32))
                     nl = c1[-1]
@@ -521,19 +551,20 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                     rank = jnp.where(valid, rank, j)
                     new_win = jnp.zeros((size,), jnp.int32).at[rank].set(
                         win, unique_indices=True)
+                    if use_ordered:
+                        # permute the ordered data windows, same ranks
+                        if not route_from_obins:
+                            wb = lax.dynamic_slice(
+                                obins, (start, 0), (size, obins.shape[1]))
+                        wwt = lax.dynamic_slice(ow, (start, 0), (size, 3))
+                        new_wb = jnp.zeros_like(wb).at[rank].set(
+                            wb, unique_indices=True)
+                        new_wt = jnp.zeros_like(wwt).at[rank].set(
+                            wwt, unique_indices=True)
+                        obins = lax.dynamic_update_slice(
+                            obins, new_wb, (start, 0))
+                        ow = lax.dynamic_update_slice(ow, new_wt, (start, 0))
                 order = lax.dynamic_update_slice(order, new_win, (start,))
-                if use_ordered:
-                    # permute the ordered data windows with the same ranks
-                    if not route_from_obins:
-                        wb = lax.dynamic_slice(
-                            obins, (start, 0), (size, obins.shape[1]))
-                    wwt = lax.dynamic_slice(ow, (start, 0), (size, 3))
-                    new_wb = jnp.zeros_like(wb).at[rank].set(
-                        wb, unique_indices=True)
-                    new_wt = jnp.zeros_like(wwt).at[rank].set(
-                        wwt, unique_indices=True)
-                    obins = lax.dynamic_update_slice(obins, new_wb, (start, 0))
-                    ow = lax.dynamic_update_slice(ow, new_wt, (start, 0))
                 return order, obins, ow, nl
             return branch
 

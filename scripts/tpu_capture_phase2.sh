@@ -62,6 +62,14 @@ BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on \
 cat "$OUT/bench_1m_ordered.json" | tee -a "$OUT/log.txt"
 snap "ordered_bins A/B"
 
+echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
+    | tee -a "$OUT/log.txt"
+BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on,partition_impl=sort \
+    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m_ordered_sort.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_ordered_sort.json" | tee -a "$OUT/log.txt"
+snap "ordered+sort A/B"
+
 echo "== on-chip tier (incl. nibble-kernel Mosaic gate) ==" \
     | tee -a "$OUT/log.txt"
 LGBM_TPU_TESTS_ON_TPU=1 timeout 1500 python -m pytest tests/test_tpu.py \

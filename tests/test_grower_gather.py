@@ -138,3 +138,31 @@ def test_grow_partition_sort_identical():
     for a, bb in zip(outs["scatter"][0], outs["sort"][0]):
         assert np.array_equal(a, bb)
     assert np.array_equal(outs["scatter"][1], outs["sort"][1])
+
+
+def test_grow_partition_sort_with_ordered_bins_identical():
+    """sort partition carrying the leaf-ordered payloads (packed bin words
+    + bitcast weights) must match the scatter+gather baseline bit for bit."""
+    rng = np.random.RandomState(10)
+    n, f, b = 6000, 9, 47
+    bins = jnp.asarray(rng.randint(0, b, size=(n, f), dtype=np.uint8))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32))
+    c = jnp.asarray(np.ones(n, np.float32))
+    meta = FeatureMeta(num_bin=jnp.full((f,), b, jnp.int32),
+                       missing_type=jnp.zeros((f,), jnp.int32),
+                       default_bin=jnp.zeros((f,), jnp.int32),
+                       is_categorical=jnp.zeros((f,), bool))
+    fv = jnp.ones((f,), bool)
+    outs = {}
+    for ordered, impl in (("off", "scatter"), ("on", "sort")):
+        cfg = GrowerConfig(num_leaves=31, min_data_in_leaf=1, max_bin=b,
+                           hist_method="segment", bucket_min_log2=6,
+                           ordered_bins=ordered, partition_impl=impl)
+        tree, row_leaf = jax.jit(make_grower(cfg))(bins, g, h, c, meta, fv)
+        outs[(ordered, impl)] = jax.tree.map(np.asarray, (tree, row_leaf))
+    ref = outs[("off", "scatter")]
+    got = outs[("on", "sort")]
+    for a, bb in zip(ref[0], got[0]):
+        assert np.array_equal(a, bb)
+    assert np.array_equal(ref[1], got[1])
