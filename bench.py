@@ -46,6 +46,14 @@ import subprocess
 import sys
 import time
 
+# persistent XLA compilation cache: the grower's ~65 s compile (remote
+# tunnel) is paid once per (config, shape) EVER — capture stages and
+# relaunched bench runs load the executable from disk in seconds.  Set
+# before any jax import so the child workload processes inherit it.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+
 BASELINE_TREES_PER_SEC_1M = 2.5285 * 28  # see module docstring
 
 

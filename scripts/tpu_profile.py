@@ -7,10 +7,18 @@ optional jax.profiler trace.
 
     python scripts/tpu_profile.py [rows] [trace_dir]
 """
+import os
 import sys
 import time
 
 import numpy as np
+
+# persistent XLA compilation cache (shared with bench.py): the sweep's
+# per-config recompiles hit disk instead of the remote compile service
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
 
 
 def make_data(n, f=28, seed=42):

@@ -16,6 +16,12 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# persistent XLA compilation cache: repeat suite runs skip recompiles of
+# unchanged jitted graphs (same mechanism bench.py uses for the TPU)
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
 
 if os.environ.get("LGBM_TPU_TESTS_ON_TPU") != "1":
     import jax
