@@ -32,13 +32,28 @@ if ! timeout 120 python -c "import jax; print(jax.devices())" \
     exit 1
 fi
 
-echo "== microprobe (latency vs device time) ==" | tee -a "$OUT/log.txt"
+alive_or_abort() {
+    # the tunnel dies mid-capture routinely; a dead stage burns its full
+    # timeout, so probe cheaply between stages and bail out — the watcher
+    # (WATCH_ONCE=0) resumes probing and a revived window re-runs the
+    # remaining stages with all compiles already in the persistent cache
+    if ! timeout 90 python -c \
+            "import jax; assert jax.devices()[0].platform == 'tpu'" \
+            >/dev/null 2>&1; then
+        echo "tunnel died after stage '$1' - aborting capture" \
+            | tee -a "$OUT/log.txt"
+        snap "partial (tunnel died after $1)"
+        exit 1
+    fi
+}
+
 echo "== headline bench 1M (retuned grower) ==" | tee -a "$OUT/log.txt"
 BENCH_TREES=10 BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_1m.json" | tee -a "$OUT/log.txt"
 snap "headline bench"
 
+alive_or_abort "headline"
 echo "== gather_words A/B (words off) ==" | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
     BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
@@ -46,6 +61,7 @@ BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
 cat "$OUT/bench_1m_nowords.json" | tee -a "$OUT/log.txt"
 snap "gather_words A/B"
 
+alive_or_abort "gather_words A/B"
 echo "== partition_impl=sort A/B (payload sort vs rank scatter) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=sort \
@@ -54,6 +70,7 @@ BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=sort \
 cat "$OUT/bench_1m_sortpart.json" | tee -a "$OUT/log.txt"
 snap "sort-partition A/B"
 
+alive_or_abort "sort A/B"
 echo "== ordered_bins A/B (leaf-ordered matrix vs gather) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on \
@@ -62,6 +79,7 @@ BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on \
 cat "$OUT/bench_1m_ordered.json" | tee -a "$OUT/log.txt"
 snap "ordered_bins A/B"
 
+alive_or_abort "ordered A/B"
 echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on,partition_impl=sort \
@@ -70,6 +88,7 @@ BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on,partition_impl=sort \
 cat "$OUT/bench_1m_ordered_sort.json" | tee -a "$OUT/log.txt"
 snap "ordered+sort A/B"
 
+alive_or_abort "ordered+sort A/B"
 echo "== on-chip tier (incl. nibble-kernel Mosaic gate) ==" \
     | tee -a "$OUT/log.txt"
 LGBM_TPU_TESTS_ON_TPU=1 timeout 1500 python -m pytest tests/test_tpu.py \
@@ -77,6 +96,7 @@ LGBM_TPU_TESTS_ON_TPU=1 timeout 1500 python -m pytest tests/test_tpu.py \
 tail -6 "$OUT/log.txt"
 snap "on-chip tier"
 
+alive_or_abort "on-chip tier"
 echo "== nibble kernel A/B bench ==" | tee -a "$OUT/log.txt"
 # only worth a bench slot if the Mosaic gate just passed (a failed gate
 # means the same compile error would burn this stage's whole timeout)
@@ -93,6 +113,7 @@ else
         | tee -a "$OUT/log.txt"
 fi
 
+alive_or_abort "nibble A/B"
 echo "== bench 63-bin (the reference's own GPU benchmark setting) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=10 BENCH_MAX_BIN=63 BENCH_STAGE_TIMEOUT=1200 \
@@ -101,18 +122,21 @@ BENCH_TREES=10 BENCH_MAX_BIN=63 BENCH_STAGE_TIMEOUT=1200 \
 cat "$OUT/bench_1m_63bin.json" | tee -a "$OUT/log.txt"
 snap "63-bin bench"
 
+alive_or_abort "63-bin"
 echo "== microprobe (latency vs device time) ==" | tee -a "$OUT/log.txt"
 timeout 1800 python scripts/tpu_microprobe.py 1000000 \
     > "$OUT/microprobe.json" 2>> "$OUT/log.txt"
 cat "$OUT/microprobe.json" | tee -a "$OUT/log.txt"
 snap "microprobe"
 
+alive_or_abort "microprobe"
 echo "== profile sweep ==" | tee -a "$OUT/log.txt"
 timeout 1800 python scripts/tpu_profile.py 1000000 \
     >> "$OUT/log.txt" 2>&1
 tail -40 "$OUT/log.txt"
 snap "profile sweep"
 
+alive_or_abort "profile sweep"
 echo "== bench wide (Epsilon-shaped) ==" | tee -a "$OUT/log.txt"
 BENCH_ROWS=200000 BENCH_ROWS_CPU=200000 BENCH_FEATURES=2000 \
     BENCH_TREES=5 BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
@@ -120,6 +144,7 @@ BENCH_ROWS=200000 BENCH_ROWS_CPU=200000 BENCH_FEATURES=2000 \
 cat "$OUT/bench_wide.json" | tee -a "$OUT/log.txt"
 snap "wide bench"
 
+alive_or_abort "wide bench"
 echo "== bench sparse (EFB + nibble packing) ==" | tee -a "$OUT/log.txt"
 BENCH_ROWS=1000000 BENCH_ROWS_CPU=1000000 BENCH_SPARSITY=0.9 \
     BENCH_FEATURES=100 BENCH_TREES=5 \
