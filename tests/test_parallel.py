@@ -170,3 +170,19 @@ def test_multiclass_data_parallel():
                     num_boost_round=10, verbose_eval=False)
     pred = bst.predict(X)
     assert float(np.mean(pred.argmax(axis=1) == labels)) > 0.85
+
+
+def test_data_parallel_ordered_sort_matches_serial(data):
+    """ordered_bins + sort partition compose with the data-parallel mesh:
+    every shard maintains its leaf-ordered local matrix and the psum'd
+    histograms reproduce the serial tree exactly."""
+    X, y, Xt, yt = data
+    auc_serial, bst_s = _train_auc(X, y, Xt, yt, {"tree_learner": "serial"})
+    auc_os, bst_o = _train_auc(
+        X, y, Xt, yt, {"tree_learner": "data", "ordered_bins": "on",
+                       "partition_impl": "sort",
+                       "enable_bin_packing": False})
+    assert auc_os == pytest.approx(auc_serial, abs=5e-3)
+    t_s, t_o = bst_s.inner.models[0], bst_o.inner.models[0]
+    np.testing.assert_array_equal(t_s.split_feature, t_o.split_feature)
+    np.testing.assert_array_equal(t_s.threshold_bin, t_o.threshold_bin)
