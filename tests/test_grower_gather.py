@@ -166,3 +166,24 @@ def test_grow_partition_sort_with_ordered_bins_identical():
     for a, bb in zip(ref[0], got[0]):
         assert np.array_equal(a, bb)
     assert np.array_equal(ref[1], got[1])
+
+
+@pytest.mark.parametrize("ordered,impl", [("off", "sort"), ("on", "sort")])
+def test_grow_missing_routing_ordered_sort(ordered, impl):
+    """NaN- and zero-missing routing decisions must survive the ordered /
+    sort paths bit for bit (default_left handling happens on the routing
+    column read, which differs per path)."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(12)
+    n = 4000
+    X = rng.randn(n, 6)
+    X[rng.rand(n, 6) < 0.15] = np.nan          # NaN missing
+    X[:, 2] = np.where(rng.rand(n) < 0.5, 0.0, X[:, 2])  # zero-heavy col
+    y = ((np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1])) > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5, "use_missing": True,
+            "enable_bin_packing": False}
+    ref = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=5)
+    got = lgb.train(dict(base, ordered_bins=ordered, partition_impl=impl),
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    assert ref.model_to_string() == got.model_to_string()
