@@ -212,6 +212,8 @@ class GBDT:
                           else cfg.ordered_bins),
             partition_impl=("scatter" if cfg.partition_impl == "auto"
                             else cfg.partition_impl),
+            bucket_scheme=("pow2" if cfg.bucket_scheme == "auto"
+                           else cfg.bucket_scheme),
             has_categorical=bool(np.asarray(fm["is_categorical"]).any()),
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_group=cfg.max_cat_group,

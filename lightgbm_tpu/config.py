@@ -237,6 +237,9 @@ class Config:
     partition_impl: str = "auto"   # window partition: auto | scatter | sort
                                    # (sort = stable 1-bit-key payload sort,
                                    # no random HBM access)
+    bucket_scheme: str = "auto"    # gather-bucket sizes: auto | pow2 | pow15
+                                   # (pow15 adds 1.5*2^k buckets: ~16% less
+                                   # padded work, 2x the compiled branches)
 
     pipeline_trees: bool = True    # pipeline tree materialization: keep
     # freshly grown trees on device and pull them to host a few iterations
@@ -390,6 +393,9 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.partition_impl not in ("auto", "scatter", "sort"):
         log.fatal("partition_impl must be auto, scatter, or sort; got %r",
                   cfg.partition_impl)
+    if cfg.bucket_scheme not in ("auto", "pow2", "pow15"):
+        log.fatal("bucket_scheme must be auto, pow2, or pow15; got %r",
+                  cfg.bucket_scheme)
     if cfg.pallas_hist_impl == "nibble":
         # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
         # axis and tiles (feat_tile * 16) output lanes — reject shapes it

@@ -64,6 +64,7 @@ class GrowerConfig(NamedTuple):
     hist_impl: str = "auto"          # pallas kernel form: onehot | nibble
     ordered_bins: str = "off"        # leaf-ordered bin matrix: on | off
     partition_impl: str = "scatter"  # window partition: scatter | sort
+    bucket_scheme: str = "pow2"      # gather-bucket sizes: pow2 | pow15
     has_categorical: bool = False    # static: enables the categorical path
     max_cat_threshold: int = 256
     max_cat_group: int = 64
@@ -323,11 +324,31 @@ def _depth_gate(res: SplitResult, leaf_depth, max_depth) -> SplitResult:
                         gain=jnp.where(ok, res.gain, -jnp.inf))
 
 
-def _bucket_index(scnt, kmin: int, kmax: int):
-    """Index of the smallest pow2 bucket holding ``scnt`` rows: exact
-    integer comparisons against a static power table (a float log2 would
+def _bucket_sizes(cfg: "GrowerConfig", n: int):
+    """Static gather-bucket size table covering [1, n].
+
+    ``pow2``: {2^k} — avg padding ~1.44x of the leaf count.
+    ``pow15``: {2^k, 3*2^(k-1)} — avg padding ~1.21x at 2x the branch
+    count (compile cost is one-time via the persistent cache; runtime
+    executes exactly one branch either way).  Every size is a multiple
+    of 512, so any Pallas row_tile that divides the min bucket divides
+    them all."""
+    kmin = cfg.bucket_min_log2
+    kmax = max(int(n - 1).bit_length(), kmin)
+    sizes = {1 << k for k in range(kmin, kmax + 1)}
+    if cfg.bucket_scheme == "pow15":
+        sizes |= {3 << (k - 1) for k in range(kmin + 1, kmax + 1)}
+    sizes = sorted(s for s in sizes if s < 2 * n or s == min(sizes))
+    while sizes[-1] < n:      # coverage: largest bucket must hold n rows
+        sizes.append(sizes[-1] * 2)
+    return sizes
+
+
+def _bucket_index(scnt, sizes):
+    """Index of the smallest bucket holding ``scnt`` rows: exact integer
+    comparisons against the static size table (a float log2 would
     mis-round near large powers of two and silently drop rows)."""
-    table = jnp.asarray([1 << j for j in range(kmin, kmax)], jnp.int32)
+    table = jnp.asarray(sizes[:-1], jnp.int32)
     return jnp.sum((scnt > table).astype(jnp.int32))
 
 
@@ -370,9 +391,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
               else hbins.shape[1])
 
         # pow2 gather buckets for the smaller child (static branch sizes)
-        kmin = cfg.bucket_min_log2
-        kmax = max(int(n - 1).bit_length(), kmin)
-        maxbuf = 1 << kmax
+        bsizes = _bucket_sizes(cfg, n)
+        maxbuf = bsizes[-1]
 
         # sentinel row n: weight 0, bin 0 — receives all buffer padding
         hbins_pad = jnp.concatenate(
@@ -434,23 +454,23 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 hist = unfold_packed_hist(hist, pack_plan, cfg.max_bin)
             return hist
 
-        def bucket_branch(k):
+        def bucket_branch(size):
             def branch(args):
                 order, obins, ow, sstart, scnt = args
                 if use_ordered:
                     wb = lax.dynamic_slice(
-                        obins, (sstart, 0), (1 << k, obins.shape[1]))
-                    wwt = lax.dynamic_slice(ow, (sstart, 0), (1 << k, 3))
-                    mask = (jnp.arange(1 << k, dtype=jnp.int32)
+                        obins, (sstart, 0), (size, obins.shape[1]))
+                    wwt = lax.dynamic_slice(ow, (sstart, 0), (size, 3))
+                    mask = (jnp.arange(size, dtype=jnp.int32)
                             < scnt).astype(wwt.dtype)
                     return hist_subset(wb, wwt[:, 0] * mask,
                                        wwt[:, 1] * mask, wwt[:, 2] * mask)
-                idx = lax.dynamic_slice(order, (sstart,), (1 << k,))
-                valid = jnp.arange(1 << k, dtype=jnp.int32) < scnt
+                idx = lax.dynamic_slice(order, (sstart,), (size,))
+                valid = jnp.arange(size, dtype=jnp.int32) < scnt
                 return measure(jnp.where(valid, idx, n))
             return branch
 
-        branches = [bucket_branch(k) for k in range(kmin, kmax + 1)]
+        branches = [bucket_branch(s) for s in bsizes]
 
         # ---- localized partition (DataPartition::Split,
         # data_partition.hpp:94-146).  The reference re-partitions only the
@@ -459,8 +479,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         # writes the stably-partitioned window back — O(leaf) per split,
         # not O(N).  Routing decisions follow tree.h:257-313.
 
-        def partition_branch(k):
-            size = 1 << k
+        def partition_branch(size):
 
             def branch(args):
                 (order, obins, ow, start, cnt,
@@ -568,7 +587,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 return order, obins, ow, nl
             return branch
 
-        pbranches = [partition_branch(k) for k in range(kmin, kmax + 1)]
+        pbranches = [partition_branch(s) for s in bsizes]
 
         # ---- root ----------------------------------------------------------
         root_g = strategy.reduce_scalar(jnp.sum(gw))
@@ -650,7 +669,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             #     (only that leaf's slice of ``order`` is touched) ---------
             start = state.leaf_start[l]
             cnt = state.leaf_cnt[l]
-            kp = _bucket_index(cnt, kmin, kmax)
+            kp = _bucket_index(cnt, bsizes)
             order, obins, ow, nl = lax.switch(
                 kp, pbranches,
                 (state.order, state.obins, state.ow, start, cnt,
@@ -704,7 +723,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             small_left = splits.left_count[l] <= splits.right_count[l]
             sstart = jnp.where(small_left, start, start + nl)
             scnt = jnp.where(small_left, nl, nr)   # LOCAL count of that child
-            ki = _bucket_index(scnt, kmin, kmax)
+            ki = _bucket_index(scnt, bsizes)
             hist_small = lax.switch(ki, branches,
                                     (order, obins, ow, sstart, scnt))
             hist_small = globalize(hist_small)

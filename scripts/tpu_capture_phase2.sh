@@ -123,6 +123,15 @@ cat "$OUT/bench_1m_63bin.json" | tee -a "$OUT/log.txt"
 snap "63-bin bench"
 
 alive_or_abort "63-bin"
+echo "== bucket_scheme=pow15 A/B (1.5x buckets, less padding) ==" \
+    | tee -a "$OUT/log.txt"
+BENCH_TREES=6 BENCH_EXTRA_PARAMS=bucket_scheme=pow15 \
+    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m_pow15.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_pow15.json" | tee -a "$OUT/log.txt"
+snap "pow15 A/B"
+
+alive_or_abort "pow15"
 echo "== microprobe (latency vs device time) ==" | tee -a "$OUT/log.txt"
 timeout 1800 python scripts/tpu_microprobe.py 1000000 \
     > "$OUT/microprobe.json" 2>> "$OUT/log.txt"

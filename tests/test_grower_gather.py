@@ -187,3 +187,18 @@ def test_grow_missing_routing_ordered_sort(ordered, impl):
     got = lgb.train(dict(base, ordered_bins=ordered, partition_impl=impl),
                     lgb.Dataset(X, label=y), num_boost_round=5)
     assert ref.model_to_string() == got.model_to_string()
+
+
+def test_grow_bucket_scheme_pow15_identical():
+    """pow15 buckets change only padded (masked) work — trees identical."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(13)
+    n = 5000
+    X = rng.randn(n, 8)
+    y = (X[:, 0] + 0.5 * rng.randn(n) > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+            "min_data_in_leaf": 3, "enable_bin_packing": False}
+    ref = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=5)
+    got = lgb.train(dict(base, bucket_scheme="pow15"),
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    assert ref.model_to_string() == got.model_to_string()
