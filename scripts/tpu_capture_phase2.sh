@@ -51,6 +51,8 @@ echo "== headline bench 1M (retuned grower) ==" | tee -a "$OUT/log.txt"
 BENCH_TREES=10 BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_1m.json" | tee -a "$OUT/log.txt"
+echo "jax_cache entries: $(ls .jax_cache 2>/dev/null | wc -l)" \
+    | tee -a "$OUT/log.txt"   # nonzero growth => TPU executables persist
 snap "headline bench"
 
 alive_or_abort "headline"
