@@ -151,17 +151,19 @@ def _candidate_arrays(hist, parent_g, parent_h, parent_c,
                                                    left_c_p1, cand_p1)
 
     # ---- combine with reference tie-break order -----------------------------
-    # [F, 2B]: dir=-1 flipped (largest threshold first), then dir=+1 ascending
+    # [F, 2B]: dir=-1 flipped (largest threshold first), then dir=+1
+    # ascending.  The four per-candidate arrays travel as ONE stacked
+    # [F, 2B, 4] tensor (gain, lg, lh, lc): one flip + one concat instead
+    # of four of each, and the assembly reads all four with one gather.
     def pack(a_m1, a_p1):
         return jnp.concatenate([jnp.flip(a_m1, axis=1), a_p1], axis=1)
 
-    gains = pack(gain_m1, gain_p1)
-    lg = pack(lg_m1, lg_p1)
-    lh = pack(lh_m1, lh_p1)
-    lc = pack(lc_m1, lc_p1)
+    stk_m1 = jnp.stack([gain_m1, lg_m1, lh_m1, lc_m1], axis=-1)
+    stk_p1 = jnp.stack([gain_p1, lg_p1, lh_p1, lc_p1], axis=-1)
+    packed = jnp.concatenate([jnp.flip(stk_m1, axis=1), stk_p1], axis=1)
     thr = pack(bins, bins)  # pack() flips the dir=-1 half itself
     is_m1 = pack(jnp.ones_like(bins, dtype=bool), jnp.zeros_like(bins, dtype=bool))
-    return gains, lg, lh, lc, thr, is_m1, min_gain_shift, tot_h, l1, l2
+    return packed, thr, is_m1, min_gain_shift, tot_h, l1, l2
 
 
 def _categorical_candidates(hist, parent_g, parent_h, parent_c,
@@ -313,12 +315,14 @@ def _categorical_candidates(hist, parent_g, parent_h, parent_c,
             l1, l2)
 
 
-def _result_from_index(idx, gains_flat, lg, lh, lc, thr, is_m1,
+def _result_from_index(idx, packed, thr, is_m1,
                        parent_g, parent_c, num_bin, missing_type,
                        min_gain_shift, tot_h, l1, l2, nf, b, feature_base=0):
-    """Assemble a SplitResult from a flat candidate index into [F, 2B]."""
-    neg_inf = jnp.asarray(-jnp.inf, gains_flat.dtype)
-    best_gain = gains_flat[idx]
+    """Assemble a SplitResult from a flat candidate index into [F, 2B]
+    (``packed`` stacks (gain, lg, lh, lc) on the last axis)."""
+    neg_inf = jnp.asarray(-jnp.inf, packed.dtype)
+    row = packed.reshape(-1, 4)[idx]          # one gather: all four values
+    best_gain = row[0]
     found = best_gain > neg_inf
     feature_local = (idx // (2 * b)).astype(jnp.int32)
     feature = jnp.where(found, feature_local + feature_base, -1)
@@ -329,9 +333,9 @@ def _result_from_index(idx, gains_flat, lg, lh, lc, thr, is_m1,
     force_right = (num_bin[fi] <= 2) & (missing_type[fi] == MISSING_NAN)
     default_left = jnp.where(found & force_right, False, default_left)
 
-    left_sum_g = lg.reshape(-1)[idx]
-    left_sum_h_raw = lh.reshape(-1)[idx]
-    left_count = lc.reshape(-1)[idx]
+    left_sum_g = row[1]
+    left_sum_h_raw = row[2]
+    left_count = row[3]
     right_sum_g = parent_g - left_sum_g
     right_sum_h_raw = tot_h - left_sum_h_raw
     right_count = parent_c - left_count
@@ -431,13 +435,13 @@ def best_split(hist: jnp.ndarray,
     f, b, _ = hist.shape
     use_cat = cfg.has_categorical and is_cat is not None
     num_valid = feat_valid & ~is_cat if use_cat else feat_valid
-    (gains, lg, lh, lc, thr, is_m1,
+    (packed, thr, is_m1,
      min_gain_shift, tot_h, l1, l2) = _candidate_arrays(
         hist, parent_g, parent_h, parent_c, num_bin, missing_type,
         default_bin, num_valid, cfg)
-    flat = gains.reshape(-1)
-    idx = jnp.argmax(flat)
-    num_res = _result_from_index(idx, flat, lg, lh, lc, thr, is_m1,
+    gains = packed[:, :, 0]
+    idx = jnp.argmax(gains.reshape(-1))
+    num_res = _result_from_index(idx, packed, thr, is_m1,
                                  parent_g, parent_c, num_bin, missing_type,
                                  min_gain_shift, tot_h, l1, l2, f, b,
                                  feature_base)
@@ -481,10 +485,10 @@ def per_feature_best_gain(hist: jnp.ndarray,
     features (voting_parallel_tree_learner.cpp:255-330)."""
     use_cat = cfg.has_categorical and is_cat is not None
     num_valid = feat_valid & ~is_cat if use_cat else feat_valid
-    (gains, _, _, _, _, _, min_gain_shift, _, _, _) = _candidate_arrays(
+    (packed, _, _, min_gain_shift, _, _, _) = _candidate_arrays(
         hist, parent_g, parent_h, parent_c, num_bin, missing_type,
         default_bin, num_valid, cfg)
-    best = jnp.max(gains, axis=1)
+    best = jnp.max(packed[:, :, 0], axis=1)
     # parent sums may be per-feature [F, 1] (voting learner's local stats)
     shift = jnp.asarray(min_gain_shift)
     if shift.ndim:
