@@ -614,3 +614,32 @@ def test_xentlambda_metric_value_parity(ref_bin, tmp_path):
                   callbacks=[lgb.record_evaluation(evals)])
         ours = evals["training"]["xentlambda"][-1]
         assert abs(ours - ref_val) < 1e-5, (obj, ours, ref_val)
+
+
+def test_perf_knob_matrix_training_parity(ref_bin, tmp_path):
+    """The round-4 data-movement knobs (leaf-ordered matrix, payload-sort
+    partition, pow15 buckets, word gathers forced on) are bit-neutral all
+    the way to the reference: a model trained with every knob engaged
+    predicts within the oracle envelope of the reference CLI's."""
+    data_path = "/root/reference/examples/binary_classification/binary.train"
+    if not os.path.exists(data_path):
+        pytest.skip("reference example data missing")
+    ours = lgb.train({"objective": "binary", "num_leaves": 15,
+                      "min_data_in_leaf": 20, "verbose": -1,
+                      "ordered_bins": "on", "partition_impl": "sort",
+                      "bucket_scheme": "pow15", "gather_words": "on",
+                      "enable_bin_packing": False},
+                     lgb.Dataset(data_path), num_boost_round=6)
+    model_path = tmp_path / "knobs_ref.txt"
+    conf = tmp_path / "knobs.conf"
+    conf.write_text(
+        f"task=train\nobjective=binary\ndata={data_path}\nnum_trees=6\n"
+        "num_leaves=15\nmin_data_in_leaf=20\n"
+        f"output_model={model_path}\nverbosity=-1\n")
+    subprocess.run([ref_bin, f"config={conf}"], check=True,
+                   capture_output=True, timeout=300)
+    ref = lgb.Booster(model_file=str(model_path))
+    X, _, _ = load_text_file(data_path, label_idx=0)
+    np.testing.assert_allclose(
+        np.asarray(ours.predict(X)), np.asarray(ref.predict(X)),
+        rtol=1e-4, atol=1e-4)
