@@ -186,7 +186,6 @@ def _categorical_candidates(hist, parent_g, parent_h, parent_c,
     T = min(int(cfg.max_cat_threshold), b)
     g = hist[:, :, 0]
     h = hist[:, :, 1]
-    c = hist[:, :, 2]
     nb = num_bin                                  # [F]
     # used_bin = num_bin - 1 + (missing == None): the overflow/NaN bin is
     # excluded from the scan unless the mapper saw every category
@@ -220,35 +219,34 @@ def _categorical_candidates(hist, parent_g, parent_h, parent_c,
     key = jnp.where(in_scan, key, jnp.inf)        # invalid bins sort last
     order = jnp.argsort(key, axis=1)              # [F, B] bin ids, ascending
 
-    sg = jnp.take_along_axis(g, order, axis=1)
-    sh = jnp.take_along_axis(h, order, axis=1)
-    sc = jnp.take_along_axis(c, order, axis=1)
-    csg = jnp.cumsum(sg, axis=1)
-    csh = jnp.cumsum(sh, axis=1)
-    csc = jnp.cumsum(sc, axis=1)
+    # channel-stacked: ONE sorted gather / cumsum / prefix read over
+    # [F, B, 3] instead of three of each (same op-launch rationale as the
+    # numerical scan above)
+    shist = jnp.take_along_axis(hist, order[:, :, None], axis=1)
+    cs = jnp.cumsum(shist, axis=1)                # [F, B, 3]
     last = jnp.clip(used_bin - 1, 0, b - 1)[:, None]
-    tg = jnp.take_along_axis(csg, last, axis=1)[:, 0]
-    th_ = jnp.take_along_axis(csh, last, axis=1)[:, 0]
-    tc = jnp.take_along_axis(csc, last, axis=1)[:, 0]
+    tot = jnp.take_along_axis(cs, last[:, :, None], axis=1)[:, 0]  # [F, 3]
+    tg, th_, tc = tot[:, 0], tot[:, 1], tot[:, 2]
 
     pos = jnp.arange(T, dtype=jnp.int32)[None, :]            # [1, T]
     # dir=+1: prefix of the sorted order
     take_p1 = jnp.minimum(pos, b - 1)
-    lg_p1 = jnp.take_along_axis(csg, take_p1, axis=1)
-    lh_p1 = jnp.take_along_axis(csh, take_p1, axis=1)
-    lc_p1 = jnp.take_along_axis(csc, take_p1, axis=1)
-    csc_sorted_c = jnp.take_along_axis(sc, take_p1, axis=1)  # step counts
+    pre_p1 = jnp.take_along_axis(cs, take_p1[:, :, None], axis=1)  # [F, T, 3]
+    lg_p1 = pre_p1[:, :, 0]
+    lh_p1 = pre_p1[:, :, 1]
+    lc_p1 = pre_p1[:, :, 2]
+    csc_sorted_c = jnp.take_along_axis(shist[:, :, 2], take_p1, axis=1)
     # dir=-1: prefix of the reversed order = totals minus cumsum at ub-2-i
     idx_m1 = used_bin[:, None] - 2 - pos                     # may be < 0
     clip_m1 = jnp.clip(idx_m1, 0, b - 1)
-    pre_g = jnp.where(idx_m1 >= 0, jnp.take_along_axis(csg, clip_m1, axis=1), 0.0)
-    pre_h = jnp.where(idx_m1 >= 0, jnp.take_along_axis(csh, clip_m1, axis=1), 0.0)
-    pre_c = jnp.where(idx_m1 >= 0, jnp.take_along_axis(csc, clip_m1, axis=1), 0.0)
-    lg_m1 = tg[:, None] - pre_g
-    lh_m1 = th_[:, None] - pre_h
-    lc_m1 = tc[:, None] - pre_c
+    pre_m1 = jnp.where((idx_m1 >= 0)[:, :, None],
+                       jnp.take_along_axis(cs, clip_m1[:, :, None], axis=1),
+                       0.0)                                  # [F, T, 3]
+    lg_m1 = tg[:, None] - pre_m1[:, :, 0]
+    lh_m1 = th_[:, None] - pre_m1[:, :, 1]
+    lc_m1 = tc[:, None] - pre_m1[:, :, 2]
     step_m1 = jnp.clip(used_bin[:, None] - 1 - pos, 0, b - 1)
-    sc_m1 = jnp.take_along_axis(sc, step_m1, axis=1)
+    sc_m1 = jnp.take_along_axis(shist[:, :, 2], step_m1, axis=1)
 
     # dir=-1 skipped when full-categorical and 2*max_cat_threshold covers all
     # bins (feature_histogram.hpp:134-138)
