@@ -215,6 +215,7 @@ class GBDT:
             bucket_scheme=("pow2" if cfg.bucket_scheme == "auto"
                            else cfg.bucket_scheme),
             has_categorical=bool(np.asarray(fm["is_categorical"]).any()),
+            has_missing=bool((np.asarray(fm["missing_type"]) != 0).any()),
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_group=cfg.max_cat_group,
             cat_smooth_ratio=cfg.cat_smooth_ratio,

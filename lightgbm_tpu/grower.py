@@ -66,6 +66,7 @@ class GrowerConfig(NamedTuple):
     partition_impl: str = "scatter"  # window partition: scatter | sort
     bucket_scheme: str = "pow2"      # gather-bucket sizes: pow2 | pow15
     has_categorical: bool = False    # static: enables the categorical path
+    has_missing: bool = True         # static: False skips the dir=+1 scan
     max_cat_threshold: int = 256
     max_cat_group: int = 64
     cat_smooth_ratio: float = 0.01
@@ -75,7 +76,8 @@ class GrowerConfig(NamedTuple):
     def split_config(self) -> SplitConfig:
         return SplitConfig(self.lambda_l1, self.lambda_l2, self.min_gain_to_split,
                            self.min_data_in_leaf, self.min_sum_hessian_in_leaf,
-                           self.has_categorical, self.max_cat_threshold,
+                           self.has_categorical, self.has_missing,
+                           self.max_cat_threshold,
                            self.max_cat_group, self.cat_smooth_ratio,
                            self.min_cat_smooth, self.max_cat_smooth)
 

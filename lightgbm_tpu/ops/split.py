@@ -37,6 +37,10 @@ class SplitConfig(NamedTuple):
     min_sum_hessian_in_leaf: float = 1e-3
     # categorical split search (feature_histogram.hpp:104-223)
     has_categorical: bool = False   # static: skip the cat path entirely if off
+    has_missing: bool = True        # static: False skips the dir=+1 scan —
+    #                                 without missing values no feature is
+    #                                 two_dir (feature_histogram.hpp runs a
+    #                                 single direction then too)
     max_cat_threshold: int = 256
     max_cat_group: int = 64
     cat_smooth_ratio: float = 0.01
@@ -138,6 +142,16 @@ def _candidate_arrays(hist, parent_g, parent_h, parent_c,
                                                    left_c_m1, cand_m1)
 
     # ---- dir = +1 : accumulate from the left; missing defaults RIGHT --------
+    # without missing values NO feature is two_dir, so the whole +1 half
+    # is statically skipped (candidate width B instead of 2B) — exactly
+    # the reference's single-direction scan for missing-free features
+    stk_m1 = jnp.stack([gain_m1, lg_m1, lh_m1, lc_m1], axis=-1)
+    if not cfg.has_missing:
+        packed = jnp.flip(stk_m1, axis=1)
+        thr = jnp.flip(bins, axis=1)
+        is_m1 = jnp.ones_like(bins, dtype=bool)
+        return packed, thr, is_m1, min_gain_shift, tot_h, l1, l2
+
     keep_p1 = ~(zero_skip & (bins == db))
     kept = jnp.where(keep_p1[:, :, None], hist, 0.0)
     left_p1 = jnp.cumsum(kept, axis=1)
@@ -158,7 +172,6 @@ def _candidate_arrays(hist, parent_g, parent_h, parent_c,
     def pack(a_m1, a_p1):
         return jnp.concatenate([jnp.flip(a_m1, axis=1), a_p1], axis=1)
 
-    stk_m1 = jnp.stack([gain_m1, lg_m1, lh_m1, lc_m1], axis=-1)
     stk_p1 = jnp.stack([gain_p1, lg_p1, lh_p1, lc_p1], axis=-1)
     packed = jnp.concatenate([jnp.flip(stk_m1, axis=1), stk_p1], axis=1)
     thr = pack(bins, bins)  # pack() flips the dir=-1 half itself
@@ -322,7 +335,8 @@ def _result_from_index(idx, packed, thr, is_m1,
     row = packed.reshape(-1, 4)[idx]          # one gather: all four values
     best_gain = row[0]
     found = best_gain > neg_inf
-    feature_local = (idx // (2 * b)).astype(jnp.int32)
+    # candidate width is B (single-direction, no missing) or 2B
+    feature_local = (idx // packed.shape[1]).astype(jnp.int32)
     feature = jnp.where(found, feature_local + feature_base, -1)
     threshold = jnp.where(found, thr.reshape(-1)[idx], 0)
     default_left = jnp.where(found, is_m1.reshape(-1)[idx], True)
