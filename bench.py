@@ -217,6 +217,9 @@ def child_main():
     trees_per_sec = n_timed / dt
     sys.stderr.write("bench " + booster.timers.report() + "\n")
 
+    link = _link_profile(jax)
+    sys.stderr.write(f"bench: link {json.dumps(link)}\n")
+
     if "BENCH_BASELINE_TPS" in os.environ:
         # an externally measured baseline is tied to the shape it was
         # measured at (BENCH_BASELINE_ROWS, default: the requested
@@ -237,7 +240,40 @@ def child_main():
         "value": round(trees_per_sec, 4),
         "unit": "trees/sec",
         "vs_baseline": round(trees_per_sec / baseline, 4),
+        "link": link,
     }))
+
+
+def _link_profile(jax):
+    """Measure the host<->device link constants (RTT, pipelined dispatch,
+    small device_get) so every bench number carries the line condition it
+    was measured under — tunnel windows vary by orders of magnitude and
+    numbers are not comparable across rounds without these."""
+    import numpy as np
+    try:
+        f = jax.jit(lambda x: x + 1)
+        x = f(np.float32(0))            # compile
+        jax.block_until_ready(x)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            jax.block_until_ready(f(x))
+        rtt_ms = (time.perf_counter() - t0) / 10 * 1e3
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(100):
+            y = f(y)
+        jax.block_until_ready(y)
+        dispatch_ms = (time.perf_counter() - t0) / 100 * 1e3
+        big = jax.device_put(np.zeros((1 << 18,), np.float32))  # 1 MB
+        jax.block_until_ready(big)
+        t0 = time.perf_counter()
+        np.asarray(big)
+        get_ms = (time.perf_counter() - t0) * 1e3
+        return {"rtt_ms": round(rtt_ms, 3),
+                "dispatch_ms": round(dispatch_ms, 3),
+                "get_1mb_ms": round(get_ms, 3)}
+    except Exception as e:              # never let diagnostics kill the bench
+        return {"error": str(e)[:120]}
 
 
 def _run_child(platform: str, pallas: bool, timeout_s: int):

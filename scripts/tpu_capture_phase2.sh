@@ -1,12 +1,17 @@
 #!/bin/bash
-# Remaining TPU evidence after the headline bench is in the bank:
-# microprobe (the latency-vs-device-time diagnosis) FIRST, then the
-# profile sweep, then the wide/sparse coverage benches.  Commits after
-# every artifact; same assumptions as tpu_capture.sh (tunnel can die at
-# any moment, most valuable artifact first).  Stages are deliberately
-# duplicated from tpu_capture.sh rather than parameterized: during a
-# live tunnel window a standalone, already-rehearsed script beats
-# editing the primary capture path.  Fire via
+# Round-5 capture playbook, priority-ordered per the round-4 verdict:
+#   1. headline bench (the driver artifact has missed four rounds — bank it)
+#   2. microprobe (name the ~3.3 ms/split residual; VERDICT #2)
+#   3. ordered_bins+sort combined A/B (the two big structural flips at once)
+#   4. nibble Mosaic gate + bench (the 2x MXU-slot win; VERDICT #3)
+#   5. 63-bin rung (the reference's own GPU benchmark setting)
+#   6. FULL Higgs 10.5M — the actual north-star shape (VERDICT #4)
+#   7. individual A/Bs to attribute the combined result
+#   8. tier / wide / sparse / profile coverage
+# Commits after every artifact; assumes the tunnel can die at any moment —
+# most valuable artifact first, cheap aliveness probe between stages, and
+# the persistent compile cache makes re-runs in later windows nearly free.
+# Fire via
 #   CAPTURE_SCRIPT=scripts/tpu_capture_phase2.sh bash scripts/tpu_watch.sh
 set -u
 cd "$(dirname "$0")/.."
@@ -47,7 +52,7 @@ alive_or_abort() {
     fi
 }
 
-echo "== headline bench 1M (retuned grower) ==" | tee -a "$OUT/log.txt"
+echo "== headline bench 1M (current defaults) ==" | tee -a "$OUT/log.txt"
 BENCH_TREES=10 BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_1m.json" | tee -a "$OUT/log.txt"
@@ -56,32 +61,14 @@ echo "jax_cache entries: $(ls .jax_cache 2>/dev/null | wc -l)" \
 snap "headline bench"
 
 alive_or_abort "headline"
-echo "== gather_words A/B (words off) ==" | tee -a "$OUT/log.txt"
-BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
-    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m_nowords.json" 2>> "$OUT/log.txt"
-cat "$OUT/bench_1m_nowords.json" | tee -a "$OUT/log.txt"
-snap "gather_words A/B"
-
-alive_or_abort "gather_words A/B"
-echo "== partition_impl=sort A/B (payload sort vs rank scatter) ==" \
+echo "== microprobe (latency vs device time; names the residual) ==" \
     | tee -a "$OUT/log.txt"
-BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=sort \
-    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m_sortpart.json" 2>> "$OUT/log.txt"
-cat "$OUT/bench_1m_sortpart.json" | tee -a "$OUT/log.txt"
-snap "sort-partition A/B"
+timeout 1500 python scripts/tpu_microprobe.py 1000000 \
+    > "$OUT/microprobe.json" 2>> "$OUT/log.txt"
+cat "$OUT/microprobe.json" | tee -a "$OUT/log.txt"
+snap "microprobe"
 
-alive_or_abort "sort A/B"
-echo "== ordered_bins A/B (leaf-ordered matrix vs gather) ==" \
-    | tee -a "$OUT/log.txt"
-BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on \
-    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m_ordered.json" 2>> "$OUT/log.txt"
-cat "$OUT/bench_1m_ordered.json" | tee -a "$OUT/log.txt"
-snap "ordered_bins A/B"
-
-alive_or_abort "ordered A/B"
+alive_or_abort "microprobe"
 echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on,partition_impl=sort \
@@ -91,17 +78,9 @@ cat "$OUT/bench_1m_ordered_sort.json" | tee -a "$OUT/log.txt"
 snap "ordered+sort A/B"
 
 alive_or_abort "ordered+sort A/B"
-echo "== on-chip tier (incl. nibble-kernel Mosaic gate) ==" \
-    | tee -a "$OUT/log.txt"
-LGBM_TPU_TESTS_ON_TPU=1 timeout 1500 python -m pytest tests/test_tpu.py \
-    -q >> "$OUT/log.txt" 2>&1
-tail -6 "$OUT/log.txt"
-snap "on-chip tier"
-
-alive_or_abort "on-chip tier"
-echo "== nibble kernel A/B bench ==" | tee -a "$OUT/log.txt"
-# only worth a bench slot if the Mosaic gate just passed (a failed gate
-# means the same compile error would burn this stage's whole timeout)
+echo "== nibble kernel Mosaic gate + A/B bench ==" | tee -a "$OUT/log.txt"
+# only worth a bench slot if the Mosaic gate passes (a failed gate means
+# the same compile error would burn this stage's whole timeout)
 if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
         "tests/test_tpu.py::test_pallas_nibble_compiles_on_tpu" \
         -q >> "$OUT/log.txt" 2>&1; then
@@ -113,9 +92,10 @@ if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
 else
     echo "nibble Mosaic gate FAILED - skipping nibble bench" \
         | tee -a "$OUT/log.txt"
+    snap "nibble gate failed"
 fi
 
-alive_or_abort "nibble A/B"
+alive_or_abort "nibble"
 echo "== bench 63-bin (the reference's own GPU benchmark setting) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=10 BENCH_MAX_BIN=63 BENCH_STAGE_TIMEOUT=1200 \
@@ -125,6 +105,38 @@ cat "$OUT/bench_1m_63bin.json" | tee -a "$OUT/log.txt"
 snap "63-bin bench"
 
 alive_or_abort "63-bin"
+echo "== FULL Higgs 10.5M x 28 (north-star shape) ==" | tee -a "$OUT/log.txt"
+BENCH_ROWS=10500000 BENCH_TREES=3 BENCH_STAGE_TIMEOUT=2400 \
+    timeout 2700 python bench.py \
+    > "$OUT/bench_higgs_full.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_higgs_full.json" | tee -a "$OUT/log.txt"
+snap "full Higgs 10.5M"
+
+alive_or_abort "full Higgs"
+echo "== ordered_bins A/B (attribution) ==" | tee -a "$OUT/log.txt"
+BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on \
+    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m_ordered.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_ordered.json" | tee -a "$OUT/log.txt"
+snap "ordered_bins A/B"
+
+alive_or_abort "ordered A/B"
+echo "== partition_impl=sort A/B (attribution) ==" | tee -a "$OUT/log.txt"
+BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=sort \
+    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m_sortpart.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_sortpart.json" | tee -a "$OUT/log.txt"
+snap "sort-partition A/B"
+
+alive_or_abort "sort A/B"
+echo "== gather_words A/B (words off) ==" | tee -a "$OUT/log.txt"
+BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
+    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m_nowords.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_nowords.json" | tee -a "$OUT/log.txt"
+snap "gather_words A/B"
+
+alive_or_abort "gather_words A/B"
 echo "== bucket_scheme=pow15 A/B (1.5x buckets, less padding) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=bucket_scheme=pow15 \
@@ -134,20 +146,13 @@ cat "$OUT/bench_1m_pow15.json" | tee -a "$OUT/log.txt"
 snap "pow15 A/B"
 
 alive_or_abort "pow15"
-echo "== microprobe (latency vs device time) ==" | tee -a "$OUT/log.txt"
-timeout 1800 python scripts/tpu_microprobe.py 1000000 \
-    > "$OUT/microprobe.json" 2>> "$OUT/log.txt"
-cat "$OUT/microprobe.json" | tee -a "$OUT/log.txt"
-snap "microprobe"
+echo "== on-chip tier ==" | tee -a "$OUT/log.txt"
+LGBM_TPU_TESTS_ON_TPU=1 timeout 1500 python -m pytest tests/test_tpu.py \
+    -q >> "$OUT/log.txt" 2>&1
+tail -6 "$OUT/log.txt"
+snap "on-chip tier"
 
-alive_or_abort "microprobe"
-echo "== profile sweep ==" | tee -a "$OUT/log.txt"
-timeout 1800 python scripts/tpu_profile.py 1000000 \
-    >> "$OUT/log.txt" 2>&1
-tail -40 "$OUT/log.txt"
-snap "profile sweep"
-
-alive_or_abort "profile sweep"
+alive_or_abort "on-chip tier"
 echo "== bench wide (Epsilon-shaped) ==" | tee -a "$OUT/log.txt"
 BENCH_ROWS=200000 BENCH_ROWS_CPU=200000 BENCH_FEATURES=2000 \
     BENCH_TREES=5 BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
@@ -170,6 +175,13 @@ BENCH_ROWS=1000000 BENCH_ROWS_CPU=1000000 BENCH_SPARSITY=0.9 \
     > "$OUT/bench_sparse_nopack.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_sparse_nopack.json" | tee -a "$OUT/log.txt"
 snap "sparse bench + packing A/B"
+
+alive_or_abort "sparse bench"
+echo "== profile sweep ==" | tee -a "$OUT/log.txt"
+timeout 1800 python scripts/tpu_profile.py 1000000 \
+    >> "$OUT/log.txt" 2>&1
+tail -40 "$OUT/log.txt"
+snap "profile sweep"
 
 echo "capture ${STAMP} complete" | tee -a "$OUT/log.txt"
 snap "final log"
