@@ -370,6 +370,12 @@ def check_param_conflicts(cfg: Config) -> None:
         log.warning("tree_learner=serial forces num_machines=1 "
                     "(config.cpp:222-225 semantics)")
         cfg.num_machines = 1
+    # the 2-D hybrid shards data x feature over ONE process's mesh; fail at
+    # parse time like the other conflicts instead of a late runtime fatal
+    if cfg.tree_learner == "data_feature" and cfg.num_machines > 1:
+        log.fatal("tree_learner=data_feature is single-process (it shards "
+                  "data x feature over one process's device mesh); use "
+                  "data, voting, or feature across machines")
     # Pallas grid knobs: catch bad values here with the real cause instead
     # of an opaque Mosaic layout error at trace/compile time
     if cfg.pallas_row_tile <= 0 or cfg.pallas_row_tile % 128 != 0:
@@ -400,10 +406,16 @@ def check_param_conflicts(cfg: Config) -> None:
         # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
         # axis and tiles (feat_tile * 16) output lanes — reject shapes it
         # cannot serve here instead of a bare assert inside jit tracing
-        if cfg.max_bin <= 128:
-            log.fatal("pallas_hist_impl=nibble needs max_bin > 128 (the "
-                      "one-hot kernel already sits on the 128-lane floor "
-                      "below that); got max_bin=%d", cfg.max_bin)
+        # bin packing widens the kernel histogram axis to the 256-bin
+        # joint index, so the gate is on the EFFECTIVE width, not raw
+        # max_bin (advisor r4)
+        eff_bins = max(256, cfg.max_bin) if cfg.enable_bin_packing \
+            else cfg.max_bin
+        if eff_bins <= 128:
+            log.fatal("pallas_hist_impl=nibble needs an effective histogram "
+                      "width > 128 (the one-hot kernel already sits on the "
+                      "128-lane floor below that); got max_bin=%d with "
+                      "enable_bin_packing=false", cfg.max_bin)
         if (cfg.pallas_feat_tile * 16) % 128 != 0:
             log.fatal("pallas_hist_impl=nibble needs pallas_feat_tile*16 "
                       "divisible by 128 (got pallas_feat_tile=%d)",

@@ -44,6 +44,7 @@ from .data.packing import PACK_JOINT_BINS, unfold_packed_hist
 from .ops.histogram import on_tpu, subset_histogram
 from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
                         best_split, leaf_output)
+from .utils import log
 
 
 class GrowerConfig(NamedTuple):
@@ -332,9 +333,12 @@ def _bucket_sizes(cfg: "GrowerConfig", n: int):
     ``pow2``: {2^k} — avg padding ~1.44x of the leaf count.
     ``pow15``: {2^k, 3*2^(k-1)} — avg padding ~1.21x at 2x the branch
     count (compile cost is one-time via the persistent cache; runtime
-    executes exactly one branch either way).  Every size is a multiple
-    of 512, so any Pallas row_tile that divides the min bucket divides
-    them all."""
+    executes exactly one branch either way).  At the default
+    bucket_min_log2 >= 10 every size is a multiple of 512 (pow2 needs
+    >= 9; pow15's smallest odd bucket is 3 << kmin), so any Pallas
+    row_tile that divides the min bucket divides them all; smaller
+    configured values rely on the kernel padding rows to a row_tile
+    multiple instead."""
     kmin = cfg.bucket_min_log2
     kmax = max(int(n - 1).bit_length(), kmin)
     sizes = {1 << k for k in range(kmin, kmax + 1)}
@@ -407,6 +411,9 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         if use_words == "auto":
             use_words = "on" if on_tpu() else "off"
         if hbins.dtype.itemsize > 2:
+            if cfg.gather_words == "on":
+                log.warning("gather_words=on ignored: bin dtype %s is wider "
+                            "than 2 bytes", hbins.dtype)
             use_words = "off"
         # leaf-ordered mode (OrderedSparseBin analogue,
         # src/io/ordered_sparse_bin.hpp): a physically leaf-ordered copy of
@@ -421,6 +428,9 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         route_from_obins = (use_ordered and hbins is hist_src
                             and hist_src is bins)
         if use_ordered:
+            if cfg.gather_words == "on":
+                log.warning("gather_words=on ignored: ordered_bins=on "
+                            "replaces the histogram row gather entirely")
             use_words = "off"         # nothing left to gather
         if use_words == "on":
             hwords_pad, words_per = pack_gather_words(hbins_pad)
@@ -538,7 +548,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                             wbw, wper = pack_gather_words(wb)
                         else:          # rare wide dtype: raw columns
                             wbw, wper = wb, None
-                        wtw = lax.bitcast_convert_type(wwt, jnp.uint32)
+                        uint_t = jnp.dtype(f"uint{wwt.dtype.itemsize * 8}")
+                        wtw = lax.bitcast_convert_type(wwt, uint_t)
                         ops = (key, win,
                                *(wbw[:, kk] for kk in range(wbw.shape[1])),
                                *(wtw[:, kk] for kk in range(3)))
@@ -550,7 +561,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                             sorted_wbw, wb.shape[1], wper).astype(wb.dtype)
                             if wper is not None else sorted_wbw)
                         new_wt = lax.bitcast_convert_type(
-                            jnp.stack(out[2 + nw:], axis=1), jnp.float32)
+                            jnp.stack(out[2 + nw:], axis=1), wwt.dtype)
                         obins = lax.dynamic_update_slice(
                             obins, new_wb, (start, 0))
                         ow = lax.dynamic_update_slice(ow, new_wt, (start, 0))

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from ..utils import log
+
 NUM_CH = 6   # weight channels: (g_hi, g_lo, h_hi, h_lo, c, unused)
 LANES = 128  # TPU vector register lane width — bin axis is padded to this
 
@@ -131,6 +133,15 @@ def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
         # lesson: interpret mode cannot see lowering failures) — 'auto'
         # stays on the hardware-proven kernel until the on-chip tier
         # passes test_pallas_nibble_* (then flip here)
+        impl = "onehot"
+    if impl == "nibble" and b_pad != 2 * LANES:
+        # the config gate is optimistic about bin packing widening the
+        # axis to 256; when no pack plan materialized the effective width
+        # stays < 129 and the factorization has nothing to win — fall
+        # back instead of tripping the shape assert inside tracing
+        log.warning("pallas_hist_impl=nibble needs a 256-wide histogram "
+                    "axis (got %d bins); using the one-hot kernel",
+                    num_bins)
         impl = "onehot"
     if impl == "nibble":
         assert b_pad == 2 * LANES and (feat_tile * NIB) % LANES == 0, \

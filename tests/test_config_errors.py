@@ -23,7 +23,11 @@ from lightgbm_tpu.config import config_from_params
     ({"pallas_feat_tile": -1}, "positive"),
     ({"gather_words": "maybe"}, "gather_words"),
     ({"pallas_hist_impl": "fancy"}, "pallas_hist_impl"),
-    ({"pallas_hist_impl": "nibble", "max_bin": 63}, "max_bin > 128"),
+    # with bin packing OFF the effective width is raw max_bin; with it ON
+    # the joint-packed axis is 256 wide and nibble is shape-valid at any
+    # max_bin (advisor r4) — only the former is rejected
+    ({"pallas_hist_impl": "nibble", "max_bin": 63,
+      "enable_bin_packing": False}, "width > 128"),
     ({"pallas_hist_impl": "nibble", "pallas_feat_tile": 4}, "divisible"),
     ({"metric": "made_up_metric", "objective": "binary"}, "metric"),
 ])
@@ -79,3 +83,12 @@ def test_all_constant_features_rejected():
         ds = lgb.Dataset(np.ones((100, 3)), label=np.zeros(100))
         lgb.train({"objective": "regression", "verbose": -1}, ds,
                   num_boost_round=1, verbose_eval=False)
+
+
+def test_data_feature_multi_machine_rejected_at_parse_time():
+    # the 2-D hybrid learner is single-process; the conflict surfaces with
+    # the other parse-time checks (config.cpp:188-240 analogue), not as a
+    # late runtime fatal in boosting
+    with pytest.raises(RuntimeError, match="data_feature.*single-process"):
+        config_from_params({"tree_learner": "data_feature",
+                            "num_machines": 2})
