@@ -235,8 +235,10 @@ class Config:
                                    # wide partition scatters for contiguous
                                    # histogram reads (no row gathers)
     partition_impl: str = "auto"   # window partition: auto | scatter | sort
-                                   # (sort = stable 1-bit-key payload sort,
-                                   # no random HBM access)
+                                   # | compact (sort = stable 1-bit-key
+                                   # payload sort; compact = Pallas two-pass
+                                   # MXU compaction kernel, all-sequential
+                                   # HBM traffic)
     bucket_scheme: str = "auto"    # gather-bucket sizes: auto | pow2 | pow15
                                    # (pow15 adds 1.5*2^k buckets: ~16% less
                                    # padded work, 2x the compiled branches)
@@ -396,9 +398,9 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.ordered_bins not in ("auto", "on", "off"):
         log.fatal("ordered_bins must be auto, on, or off; got %r",
                   cfg.ordered_bins)
-    if cfg.partition_impl not in ("auto", "scatter", "sort"):
-        log.fatal("partition_impl must be auto, scatter, or sort; got %r",
-                  cfg.partition_impl)
+    if cfg.partition_impl not in ("auto", "scatter", "sort", "compact"):
+        log.fatal("partition_impl must be auto, scatter, sort, or compact; "
+                  "got %r", cfg.partition_impl)
     if cfg.bucket_scheme not in ("auto", "pow2", "pow15"):
         log.fatal("bucket_scheme must be auto, pow2, or pow15; got %r",
                   cfg.bucket_scheme)

@@ -65,6 +65,7 @@ class GrowerConfig(NamedTuple):
     hist_impl: str = "auto"          # pallas kernel form: onehot | nibble
     ordered_bins: str = "off"        # leaf-ordered bin matrix: on | off
     partition_impl: str = "scatter"  # window partition: scatter | sort
+                                     # | compact (Pallas kernel)
     bucket_scheme: str = "pow2"      # gather-bucket sizes: pow2 | pow15
     has_categorical: bool = False    # static: enables the categorical path
     has_missing: bool = True         # static: False skips the dir=+1 scan
@@ -432,6 +433,22 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 log.warning("gather_words=on ignored: ordered_bins=on "
                             "replaces the histogram row gather entirely")
             use_words = "off"         # nothing left to gather
+        if cfg.partition_impl == "compact":
+            # the A/B harness must never record scatter numbers labeled
+            # compact — name every silent-degradation condition up front
+            if n >= (1 << 24):
+                log.warning("partition_impl=compact falls back to scatter: "
+                            "%d rows exceed the f32-exact order-id limit "
+                            "(2^24)", n)
+            if cfg.bucket_min_log2 < 9:
+                log.warning("partition_impl=compact falls back to scatter "
+                            "for buckets below 512 rows "
+                            "(pallas_bucket_min_log2=%d)",
+                            cfg.bucket_min_log2)
+            if use_ordered and dtype != jnp.float32:
+                log.warning("partition_impl=compact falls back to scatter: "
+                            "ordered_bins payload dtype %s is not float32",
+                            dtype)
         if use_words == "on":
             hwords_pad, words_per = pack_gather_words(hbins_pad)
 
@@ -525,6 +542,61 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 goes_left = jnp.where(is_cat_l, cat_go_left, goes_left)
                 goes_left = goes_left & valid
                 use_sort = cfg.partition_impl == "sort"
+                # the Pallas compaction kernel needs 512-row blocks, f32-
+                # exact window values (order ids < 2^24) and 32-bit payload
+                # columns; branches outside that contract keep the scatter
+                use_compact = (cfg.partition_impl == "compact"
+                               and size % 512 == 0 and n < (1 << 24)
+                               and (not use_ordered
+                                    or dtype == jnp.float32))
+                def payload_cols():
+                    """Ordered-mode payload marshalling shared by the sort
+                    and compact transports: slice the leaf-ordered windows
+                    and present them as 32/64-bit integer columns (bin
+                    columns packed into u32 words, weights bitcast to the
+                    matching uint)."""
+                    wbl = wb if route_from_obins else lax.dynamic_slice(
+                        obins, (start, 0), (size, obins.shape[1]))
+                    wwt = lax.dynamic_slice(ow, (start, 0), (size, 3))
+                    if wbl.dtype.itemsize <= 2:
+                        wbw, wper = pack_gather_words(wbl)
+                    else:          # rare wide dtype: raw columns
+                        wbw, wper = wbl, None
+                    uint_t = jnp.dtype(f"uint{wwt.dtype.itemsize * 8}")
+                    wtw = lax.bitcast_convert_type(wwt, uint_t)
+                    cols = (tuple(wbw[:, kk] for kk in range(wbw.shape[1]))
+                            + tuple(wtw[:, kk] for kk in range(3)))
+                    return cols, (wbl, wwt, wper, wbw.shape[1])
+
+                def payload_store(obins, ow, newcols, info):
+                    """Inverse of payload_cols: unpack the permuted columns
+                    and write the windows back."""
+                    wbl, wwt, wper, nw = info
+                    swbw = jnp.stack(newcols[:nw], axis=1)
+                    new_wb = (unpack_gather_words(
+                        swbw, wbl.shape[1], wper).astype(wbl.dtype)
+                        if wper is not None else swbw.astype(wbl.dtype))
+                    new_wt = lax.bitcast_convert_type(
+                        jnp.stack(newcols[nw:], axis=1), wwt.dtype)
+                    obins = lax.dynamic_update_slice(
+                        obins, new_wb, (start, 0))
+                    ow = lax.dynamic_update_slice(ow, new_wt, (start, 0))
+                    return obins, ow
+
+                if use_compact:
+                    from .ops.pallas_compact import compact_window
+                    if use_ordered:
+                        payload, info = payload_cols()
+                        new_win, newpay, nl = compact_window(
+                            win, goes_left, valid, payload,
+                            interpret=not on_tpu())
+                        obins, ow = payload_store(obins, ow, newpay, info)
+                    else:
+                        new_win, _, nl = compact_window(
+                            win, goes_left, valid, (),
+                            interpret=not on_tpu())
+                    order = lax.dynamic_update_slice(order, new_win, (start,))
+                    return order, obins, ow, nl
                 if use_sort:
                     # stable 3-way key sort: lefts (0) then rights (1);
                     # past-the-leaf slots (2) are already contiguous at
@@ -540,31 +612,11 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                                     jnp.where(goes_left, 0, 1)
                                     ).astype(jnp.int32)
                     if use_ordered:
-                        if not route_from_obins:
-                            wb = lax.dynamic_slice(
-                                obins, (start, 0), (size, obins.shape[1]))
-                        wwt = lax.dynamic_slice(ow, (start, 0), (size, 3))
-                        if wb.dtype.itemsize <= 2:
-                            wbw, wper = pack_gather_words(wb)
-                        else:          # rare wide dtype: raw columns
-                            wbw, wper = wb, None
-                        uint_t = jnp.dtype(f"uint{wwt.dtype.itemsize * 8}")
-                        wtw = lax.bitcast_convert_type(wwt, uint_t)
-                        ops = (key, win,
-                               *(wbw[:, kk] for kk in range(wbw.shape[1])),
-                               *(wtw[:, kk] for kk in range(3)))
-                        out = lax.sort(ops, is_stable=True, num_keys=1)
+                        payload, info = payload_cols()
+                        out = lax.sort((key, win, *payload),
+                                       is_stable=True, num_keys=1)
                         new_win = out[1]
-                        nw = wbw.shape[1]
-                        sorted_wbw = jnp.stack(out[2:2 + nw], axis=1)
-                        new_wb = (unpack_gather_words(
-                            sorted_wbw, wb.shape[1], wper).astype(wb.dtype)
-                            if wper is not None else sorted_wbw)
-                        new_wt = lax.bitcast_convert_type(
-                            jnp.stack(out[2 + nw:], axis=1), wwt.dtype)
-                        obins = lax.dynamic_update_slice(
-                            obins, new_wb, (start, 0))
-                        ow = lax.dynamic_update_slice(ow, new_wt, (start, 0))
+                        obins, ow = payload_store(obins, ow, out[2:], info)
                     else:
                         _, new_win = lax.sort((key, win),
                                               is_stable=True, num_keys=1)

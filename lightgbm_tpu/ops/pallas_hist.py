@@ -39,6 +39,7 @@ from ..utils import log
 
 NUM_CH = 6   # weight channels: (g_hi, g_lo, h_hi, h_lo, c, unused)
 LANES = 128  # TPU vector register lane width — bin axis is padded to this
+_nibble_warned = False
 
 
 def _hist_kernel(bins_ref, w_ref, out_ref, *, num_bins: int, feat_tile: int):
@@ -97,6 +98,11 @@ def _hist_kernel_nibble(bins_ref, w_ref, out_ref, *, feat_tile: int):
     tr = bins.shape[1]
     hi = bins >> 4                                  # [TF, TR], < 16
     lo = bins & 15
+    # per-feature [96, 16] dots are CONCATENATED along lanes and stored
+    # once as the full [96, TF*16] block: sub-lane-width (16 < 128) slice
+    # writes into out_ref are the kind of masked partial store Mosaic has
+    # historically mislowered, so the kernel never does one
+    blocks = []
     for f in range(feat_tile):
         oh_hi = (hi[f][None, :] ==
                  lax.broadcasted_iota(jnp.int32, (NIB, tr), 0)
@@ -105,8 +111,9 @@ def _hist_kernel_nibble(bins_ref, w_ref, out_ref, *, feat_tile: int):
         oh_lo = (lo[f][:, None] ==
                  lax.broadcasted_iota(jnp.int32, (tr, NIB), 1)
                  ).astype(w.dtype)                  # [TR, 16]
-        out_ref[:, f * NIB:(f + 1) * NIB] += jnp.dot(
-            u, oh_lo, preferred_element_type=jnp.float32)   # [96, 16]
+        blocks.append(jnp.dot(u, oh_lo,
+                              preferred_element_type=jnp.float32))  # [96,16]
+    out_ref[...] += jnp.concatenate(blocks, axis=1)   # [96, TF*16]
 
 
 def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
@@ -138,10 +145,15 @@ def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
         # the config gate is optimistic about bin packing widening the
         # axis to 256; when no pack plan materialized the effective width
         # stays < 129 and the factorization has nothing to win — fall
-        # back instead of tripping the shape assert inside tracing
-        log.warning("pallas_hist_impl=nibble needs a 256-wide histogram "
-                    "axis (got %d bins); using the one-hot kernel",
-                    num_bins)
+        # back instead of tripping the shape assert inside tracing.
+        # Warn once per process: the grower traces one call per gather
+        # bucket, which would repeat the identical line a dozen-plus times
+        global _nibble_warned
+        if not _nibble_warned:
+            _nibble_warned = True
+            log.warning("pallas_hist_impl=nibble needs a 256-wide histogram "
+                        "axis (got %d bins); using the one-hot kernel",
+                        num_bins)
         impl = "onehot"
     if impl == "nibble":
         assert b_pad == 2 * LANES and (feat_tile * NIB) % LANES == 0, \

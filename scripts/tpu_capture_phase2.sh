@@ -129,6 +129,26 @@ cat "$OUT/bench_1m_sortpart.json" | tee -a "$OUT/log.txt"
 snap "sort-partition A/B"
 
 alive_or_abort "sort A/B"
+echo "== compact-partition Mosaic gate + A/B bench ==" | tee -a "$OUT/log.txt"
+if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
+        "tests/test_tpu.py::test_pallas_compact_compiles_and_matches_on_tpu" \
+        -q >> "$OUT/log.txt" 2>&1; then
+    BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact \
+        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+        > "$OUT/bench_1m_compact.json" 2>> "$OUT/log.txt"
+    cat "$OUT/bench_1m_compact.json" | tee -a "$OUT/log.txt"
+    BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact,ordered_bins=on \
+        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+        > "$OUT/bench_1m_compact_ordered.json" 2>> "$OUT/log.txt"
+    cat "$OUT/bench_1m_compact_ordered.json" | tee -a "$OUT/log.txt"
+    snap "compact-partition A/B"
+else
+    echo "compact Mosaic gate FAILED - skipping compact bench" \
+        | tee -a "$OUT/log.txt"
+    snap "compact gate failed"
+fi
+
+alive_or_abort "compact"
 echo "== gather_words A/B (words off) ==" | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
     BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
