@@ -1,0 +1,25 @@
+"""Persistent-XLA-compilation-cache bootstrap shared by every in-process
+entry point (tests/conftest, scripts/*, comm audit).
+
+The container's sitecustomize imports jax at interpreter startup, BEFORE
+any script body runs — so setting ``JAX_COMPILATION_CACHE_DIR`` in the
+script is read too late and the cache silently never engages for
+in-process compiles (child subprocesses like bench.py's workload rungs
+inherit the env var early enough and are unaffected).  The fix must set
+the LIVE jax config; do it once here so new entry points cannot miss it.
+"""
+import os
+
+_DEFAULT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))), ".jax_cache")
+
+
+def enable_persistent_cache(path: str = "") -> str:
+    """Point both the env var (for child processes) and the live jax
+    config (for this process) at the repo's compile cache."""
+    path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+    import jax
+    jax.config.update("jax_compilation_cache_dir", path)
+    return path

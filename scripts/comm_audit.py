@@ -37,6 +37,9 @@ jax.config.update("jax_platforms", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from lightgbm_tpu.utils.cache import enable_persistent_cache  # noqa: E402
+enable_persistent_cache()   # live-config bootstrap; see utils/cache.py
+
 from lightgbm_tpu.grower import FeatureMeta, GrowerConfig  # noqa: E402
 from lightgbm_tpu.parallel.learner import (  # noqa: E402
     make_distributed_grower)

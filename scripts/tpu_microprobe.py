@@ -33,6 +33,9 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                  ".jax_cache"))
 
+from lightgbm_tpu.utils.cache import enable_persistent_cache  # noqa: E402
+enable_persistent_cache()   # live-config bootstrap; see utils/cache.py
+
 import numpy as np
 
 
@@ -172,6 +175,27 @@ def main():
         lambda: part_sort_fn(order, goes_left), n=5) * 1e3
     print(f"partition via stable sort {res['partition_sort_ms']:.1f} ms",
           file=sys.stderr, flush=True)
+
+    # 4f. Pallas compaction kernel head-to-head with scatter/sort (round-5
+    # candidate; ~5 ns/row projected).  TPU only: off-chip it would run in
+    # interpret mode and time nothing real.
+    if res["platform"] == "tpu":
+        try:
+            from lightgbm_tpu.ops.pallas_compact import compact_window
+            nn = n // 512 * 512
+            ordc, glc = order[:nn], goes_left[:nn]
+            validc = jnp.ones((nn,), bool)
+            comp_fn = jax.jit(lambda o, gl, v: compact_window(
+                o, gl & v, v, ())[0])
+            res["partition_compact_ms"] = _t(
+                lambda: comp_fn(ordc, glc, validc), n=5) * 1e3
+            print(f"partition via compact kernel "
+                  f"{res['partition_compact_ms']:.1f} ms",
+                  file=sys.stderr, flush=True)
+        except Exception as e:          # Mosaic rejection is itself evidence
+            res["partition_compact_error"] = str(e)[:300]
+            print(f"compact kernel probe failed: {e}",
+                  file=sys.stderr, flush=True)
 
     def part_opt(ord_, gl):
         # the production form after the round-4 retune: one cumsum

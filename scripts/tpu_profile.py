@@ -20,6 +20,9 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                  ".jax_cache"))
 
+from lightgbm_tpu.utils.cache import enable_persistent_cache  # noqa: E402
+enable_persistent_cache()   # live-config bootstrap; see utils/cache.py
+
 
 def make_data(n, f=28, seed=42):
     sys.path.insert(0, ".")

@@ -23,8 +23,17 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                  ".jax_cache"))
 
+import jax
+
+# live-config cache bootstrap (sitecustomize imports jax before this file
+# runs; see utils/cache.py).  Warm suite re-runs drop ~3x: the grower's
+# ~10 s XLA:CPU compiles are the fast tier's dominant cost.
+import sys as _sys
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lightgbm_tpu.utils.cache import enable_persistent_cache
+enable_persistent_cache()
+
 if os.environ.get("LGBM_TPU_TESTS_ON_TPU") != "1":
-    import jax
     jax.config.update("jax_platforms", "cpu")
 
 import subprocess
@@ -48,6 +57,12 @@ SLOW_TESTS = {
     "test_grid_search", "test_cv_and_cvbooster",
     "test_cv_lambdarank_group_folds",
     "test_bundled_training_matches_unbundled_exactly",
+    # 8-device-mesh trainings (the packing x distributed composition);
+    # the distributed learners themselves are covered by test_parallel in
+    # the full tier
+    "test_packed_distributed_matches_unpacked[voting]",
+    "test_packed_distributed_matches_unpacked[data]",
+    "test_feature_parallel_gates_packing_off",
 }
 
 
