@@ -207,6 +207,7 @@ class GBDT:
             row_tile=cfg.pallas_row_tile,
             bucket_min_log2=cfg.pallas_bucket_min_log2,
             gather_words=cfg.gather_words,
+            gather_panel=cfg.gather_panel,
             hist_impl=cfg.pallas_hist_impl,
             ordered_bins=("off" if cfg.ordered_bins == "auto"
                           else cfg.ordered_bins),

@@ -229,6 +229,9 @@ class Config:
     pallas_bucket_min_log2: int = 10   # smallest pow2 gather bucket
     gather_words: str = "auto"     # pack bin columns into u32 words for the
                                    # histogram row gather: auto | on | off
+    gather_panel: str = "auto"     # fold the f32 weight columns into the
+                                   # word matrix so each split's read is
+                                   # ONE row gather: auto | on | off
     pallas_hist_impl: str = "auto"  # kernel form: auto | onehot | nibble
     ordered_bins: str = "auto"     # leaf-ordered bin matrix (OrderedBin
                                    # analogue): auto | on | off; 'on' trades
@@ -392,6 +395,9 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.gather_words not in ("auto", "on", "off"):
         log.fatal("gather_words must be auto, on, or off; got %r",
                   cfg.gather_words)
+    if cfg.gather_panel not in ("auto", "on", "off"):
+        log.fatal("gather_panel must be auto, on, or off; got %r",
+                  cfg.gather_panel)
     if cfg.pallas_hist_impl not in ("auto", "onehot", "nibble"):
         log.fatal("pallas_hist_impl must be auto, onehot, or nibble; got %r",
                   cfg.pallas_hist_impl)

@@ -157,6 +157,15 @@ cat "$OUT/bench_1m_nowords.json" | tee -a "$OUT/log.txt"
 snap "gather_words A/B"
 
 alive_or_abort "gather_words A/B"
+echo "== gather_panel A/B (weights folded into the word gather) ==" \
+    | tee -a "$OUT/log.txt"
+BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_panel=off \
+    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+    > "$OUT/bench_1m_nopanel.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_nopanel.json" | tee -a "$OUT/log.txt"
+snap "gather_panel A/B"
+
+alive_or_abort "gather_panel A/B"
 echo "== bucket_scheme=pow15 A/B (1.5x buckets, less padding) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=bucket_scheme=pow15 \

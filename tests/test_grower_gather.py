@@ -202,3 +202,24 @@ def test_grow_bucket_scheme_pow15_identical():
     got = lgb.train(dict(base, bucket_scheme="pow15"),
                     lgb.Dataset(X, label=y), num_boost_round=5)
     assert ref.model_to_string() == got.model_to_string()
+
+
+def test_grow_gather_panel_identical():
+    """Folding the bitcast weight columns into the word gather (one row
+    gather per split) moves identical bits — trees bit-identical with the
+    panel on or off, with and without bagging weights."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(31)
+    n = 4000
+    X = rng.randn(n, 6)
+    y = (X[:, 0] + 0.4 * rng.randn(n) > 0).astype(float)
+    for extra in ({}, {"bagging_fraction": 0.7, "bagging_freq": 1}):
+        base = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+                "min_data_in_leaf": 5, "gather_words": "on",
+                "enable_bin_packing": False}
+        base.update(extra)
+        ref = lgb.train(dict(base, gather_panel="off"),
+                        lgb.Dataset(X, label=y), num_boost_round=4)
+        got = lgb.train(dict(base, gather_panel="on"),
+                        lgb.Dataset(X, label=y), num_boost_round=4)
+        assert ref.model_to_string() == got.model_to_string(), extra
