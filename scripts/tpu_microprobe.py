@@ -124,6 +124,29 @@ def main():
           f"{res['gather_rows_pib_ms']:.1f} / words "
           f"{res['gather_rows_words_ms']:.1f} ms", file=sys.stderr, flush=True)
 
+    # 4b2. gather panel (round 5): ONE [N, W+3] u32 row gather vs the word
+    # gather PLUS three separate f32 column gathers — prices exactly what
+    # gather_panel removes from every split
+    from jax import lax as _lax
+    # three DISTINCT arrays, like the grower's gw/hw/cw — identical
+    # operands would be CSE'd into one gather and underprice this side
+    wg, wh, wc = (jnp.asarray(rng.randn(n).astype(np.float32))
+                  for _ in range(3))
+    panel = jnp.concatenate(
+        [words] + [_lax.bitcast_convert_type(w, jnp.uint32)[:, None]
+                   for w in (wg, wh, wc)], axis=1)
+    jax.block_until_ready(panel)
+    g3 = jax.jit(lambda o: (words.at[o].get(mode="promise_in_bounds"),
+                            wg.at[o].get(mode="promise_in_bounds"),
+                            wh.at[o].get(mode="promise_in_bounds"),
+                            wc.at[o].get(mode="promise_in_bounds")))
+    res["gather_words_plus3_ms"] = _t(lambda: g3(perm), n=5) * 1e3
+    gp = jax.jit(lambda o: panel.at[o].get(mode="promise_in_bounds"))
+    res["gather_panel_ms"] = _t(lambda: gp(perm), n=5) * 1e3
+    print(f"gather panel A/B: words+3cols "
+          f"{res['gather_words_plus3_ms']:.1f} / panel "
+          f"{res['gather_panel_ms']:.1f} ms", file=sys.stderr, flush=True)
+
     # 4c. does a row scatter cost per INDEX or per ELEMENT?  If per index,
     # the leaf-ordered-bins design (permuting [window, F] data rows with
     # the same scatter that permutes `order`) is nearly free and deletes
