@@ -217,6 +217,11 @@ def test_pallas_compact_compiles_and_matches_on_tpu(tpu):
     exp = win.copy()
     exp[:cnt] = win[order]
     np.testing.assert_array_equal(np.asarray(nw), exp)
+    # the no-payload shape (cp=3, narrowest unaligned DMA width) must
+    # ALSO lower — the bench A/B without ordered_bins runs exactly this
+    nw0, _, _ = jax.jit(lambda w, g, v: compact_window(w, g, v, ()))(
+        jnp.asarray(win), jnp.asarray(gl), jnp.asarray(valid))
+    np.testing.assert_array_equal(np.asarray(nw0), exp)
     ep = pay[0].copy()
     ep[:cnt] = pay[0][order]
     np.testing.assert_array_equal(np.asarray(npay[0]), ep)
