@@ -105,7 +105,24 @@ def _construct_cached(X, y, cfg, n_rows, n_feat, sparsity, params):
     if not cache_dir:
         return construct(X, cfg, label=y)
     import hashlib
-    extras = os.environ.get("BENCH_EXTRA_PARAMS", "")
+    from lightgbm_tpu.config import canonicalize_params
+    # only binning-relevant extras key the cache: grower knobs (gather_*,
+    # partition_impl, ordered_bins, bin packing, ...) never change the
+    # constructed dataset, and hashing them would make every A/B stage
+    # re-bin during a live tunnel window.  Keys are canonicalized first so
+    # aliases/case/whitespace neither miss the filter nor alias a stale
+    # entry.  The set mirrors what lightgbm_tpu/data/ actually reads at
+    # construction (incl. min_data_in_leaf's trivial-feature pre-filter
+    # and the bin-sample seed).
+    binning_keys = {"enable_bundle", "max_bin", "min_data_in_bin",
+                    "use_missing", "zero_as_missing",
+                    "bin_construct_sample_cnt", "max_conflict_rate",
+                    "min_data_in_leaf", "data_random_seed"}
+    raw = dict(kv.partition("=")[::2] for kv in filter(
+        None, os.environ.get("BENCH_EXTRA_PARAMS", "").split(",")))
+    canon = canonicalize_params(raw)
+    extras = ",".join(f"{k}={v}" for k, v in sorted(canon.items())
+                      if k in binning_keys)
     xh = hashlib.md5(extras.encode()).hexdigest()[:8] if extras else "0"
     # version salt: a binning-code change must invalidate cached datasets,
     # or the bench would attribute stale-bin numbers to the code under test
