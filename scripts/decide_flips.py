@@ -1,0 +1,104 @@
+"""Read a tpu_capture_* directory and print the default-flip decision table.
+
+Mechanizes the PERF.md playbook: each A/B artifact is compared against the
+headline bench (same platform only — a CPU-fallback A/B must never decide
+a TPU default), flagged WIN/LOSE/NOISE with the >=5% criterion, and the
+table states exactly which knob to flip where.  Decisions still land as
+code edits (boosting.py auto-resolution block) — this script only reads.
+
+Usage: python scripts/decide_flips.py docs/tpu_capture_<stamp>/
+"""
+import json
+import os
+import sys
+
+FLIPS = [
+    ("bench_1m_ordered_sort.json", "ordered_bins=on + partition_impl=sort",
+     "flip BOTH autos in boosting.py if >=5% over headline"),
+    ("bench_1m_compact.json", "partition_impl=compact",
+     "partition_impl auto->compact on TPU"),
+    ("bench_1m_compact_ordered.json", "compact + ordered_bins",
+     "flip both if this beats every other combo"),
+    ("bench_1m_ordered.json", "ordered_bins=on", "ordered_bins auto->on"),
+    ("bench_1m_sortpart.json", "partition_impl=sort",
+     "partition_impl auto->sort"),
+    ("bench_1m_nowords.json", "gather_words=off",
+     "gather_words auto->off on TPU if OFF wins (panel rides words)"),
+    ("bench_1m_nopanel.json", "gather_panel=off",
+     "keep gather_panel auto-on unless OFF wins"),
+    ("bench_1m_nibble.json", "pallas_hist_impl=nibble",
+     "hist6_pallas 'auto' -> nibble at B_pad=256 (ops/pallas_hist.py)"),
+    ("bench_1m_pow15.json", "bucket_scheme=pow15",
+     "bucket_scheme auto->pow15"),
+    ("bench_1m_63bin.json", "max_bin=63 (config rung, not a flip)", "-"),
+    ("bench_higgs_full.json", "10.5M north-star shape (coverage)", "-"),
+    ("bench_wide.json", "Epsilon-wide shape (coverage)", "-"),
+    ("bench_sparse.json", "sparse+EFB (coverage)", "-"),
+    ("bench_sparse_nopack.json", "enable_bin_packing=false",
+     "flip packing default off on TPU if OFF wins the sparse A/B"),
+]
+
+
+def load(path):
+    try:
+        with open(path) as f:
+            for line in reversed(f.read().strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    return json.loads(line)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return None
+
+
+def platform(d):
+    m = d.get("metric", "")
+    return "tpu" if "(tpu" in m else "cpu" if "(cpu" in m else "?"
+
+
+def main():
+    cap = sys.argv[1]
+    head = load(os.path.join(cap, "bench_1m.json"))
+    if not head:
+        print("no headline bench in", cap)
+        return
+    hp, hv = platform(head), head["value"]
+    deg = " DEGRADED" if "degraded" in head else ""
+    print(f"headline: {hv} trees/s ({hp}{deg}) "
+          f"vs_baseline={head.get('vs_baseline')} "
+          f"link={head.get('link')}")
+    print()
+    print(f"{'artifact':34} {'trees/s':>9} {'vs head':>8}  verdict / action")
+    for fname, knob, action in FLIPS:
+        d = load(os.path.join(cap, fname))
+        if d is None:
+            print(f"{fname:34} {'—':>9} {'—':>8}  (not captured)")
+            continue
+        p, v = platform(d), d["value"]
+        if p != hp:
+            print(f"{fname:34} {v:>9} {'—':>8}  platform {p} != headline "
+                  f"{hp}: NOT comparable, no decision")
+            continue
+        if fname.startswith(("bench_higgs", "bench_wide", "bench_sparse.")):
+            print(f"{fname:34} {v:>9} {'—':>8}  coverage shape "
+                  f"(vs_baseline={d.get('vs_baseline')})")
+            continue
+        ratio = v / hv if hv else float("inf")
+        verdict = ("WIN" if ratio >= 1.05
+                   else "LOSE" if ratio <= 0.95 else "NOISE")
+        print(f"{fname:34} {v:>9} {ratio:>8.3f}  {verdict}: {knob}")
+        if verdict == "WIN":
+            print(f"{'':53}-> {action}")
+    mp = load(os.path.join(cap, "microprobe.json"))
+    if mp:
+        print()
+        print("microprobe decomposition:",
+              {k: round(mp[k], 3) for k in
+               ("grow_per_split_fixed_ms", "grow_per_mrow_ms", "grow_ms",
+                "partition_compact_ms", "partition_sort_ms",
+                "partition_window_opt_ms", "gather_panel_ms",
+                "gather_words_plus3_ms") if k in mp})
+
+
+if __name__ == "__main__":
+    main()
