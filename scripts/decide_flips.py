@@ -1,10 +1,12 @@
 """Read a tpu_capture_* directory and print the default-flip decision table.
 
-Mechanizes the PERF.md playbook: each A/B artifact is compared against the
-headline bench (same platform only — a CPU-fallback A/B must never decide
-a TPU default), flagged WIN/LOSE/NOISE with the >=5% criterion, and the
-table states exactly which knob to flip where.  Decisions still land as
-code edits (boosting.py auto-resolution block) — this script only reads.
+Mechanizes the PERF.md playbook: each A/B artifact is compared against its
+matched baseline (the 1M headline, except the sparse packing A/B which is
+judged against bench_sparse.json), flagged WIN/LOSE/NOISE with the >=5%
+criterion.  Decisions require clean TPU numbers on BOTH sides — degraded
+or CPU-fallback artifacts never decide a TPU default.  Decisions still
+land as code edits (boosting.py auto-resolution block) — this script only
+reads.
 
 Usage: python scripts/decide_flips.py docs/tpu_capture_<stamp>/
 """
@@ -12,31 +14,33 @@ import json
 import os
 import sys
 
+# (artifact, knob, action, baseline_artifact or None=headline)
 FLIPS = [
     ("bench_1m_ordered_sort.json", "ordered_bins=on + partition_impl=sort",
-     "flip BOTH autos in boosting.py if >=5% over headline"),
+     "flip BOTH autos in boosting.py", None),
     ("bench_1m_compact.json", "partition_impl=compact",
-     "partition_impl auto->compact on TPU"),
+     "partition_impl auto->compact on TPU", None),
     ("bench_1m_compact_ordered.json", "compact + ordered_bins",
-     "flip both if this beats every other combo"),
-    ("bench_1m_ordered.json", "ordered_bins=on", "ordered_bins auto->on"),
+     "flip both if this beats every other combo", None),
+    ("bench_1m_ordered.json", "ordered_bins=on", "ordered_bins auto->on",
+     None),
     ("bench_1m_sortpart.json", "partition_impl=sort",
-     "partition_impl auto->sort"),
+     "partition_impl auto->sort", None),
     ("bench_1m_nowords.json", "gather_words=off",
-     "gather_words auto->off on TPU if OFF wins (panel rides words)"),
+     "gather_words auto->off on TPU if OFF wins (panel rides words)", None),
     ("bench_1m_nopanel.json", "gather_panel=off",
-     "keep gather_panel auto-on unless OFF wins"),
+     "keep gather_panel auto-on unless OFF wins", None),
     ("bench_1m_nibble.json", "pallas_hist_impl=nibble",
-     "hist6_pallas 'auto' -> nibble at B_pad=256 (ops/pallas_hist.py)"),
+     "hist6_pallas 'auto' -> nibble at B_pad=256 (ops/pallas_hist.py)",
+     None),
     ("bench_1m_pow15.json", "bucket_scheme=pow15",
-     "bucket_scheme auto->pow15"),
-    ("bench_1m_63bin.json", "max_bin=63 (config rung, not a flip)", "-"),
-    ("bench_higgs_full.json", "10.5M north-star shape (coverage)", "-"),
-    ("bench_wide.json", "Epsilon-wide shape (coverage)", "-"),
-    ("bench_sparse.json", "sparse+EFB (coverage)", "-"),
+     "bucket_scheme auto->pow15", None),
     ("bench_sparse_nopack.json", "enable_bin_packing=false",
-     "flip packing default off on TPU if OFF wins the sparse A/B"),
+     "flip packing default off on TPU if OFF wins",
+     "bench_sparse.json"),
 ]
+COVERAGE = ["bench_1m_63bin.json", "bench_higgs_full.json",
+            "bench_wide.json", "bench_sparse.json"]
 
 
 def load(path):
@@ -56,37 +60,53 @@ def platform(d):
     return "tpu" if "(tpu" in m else "cpu" if "(cpu" in m else "?"
 
 
+def clean_tpu(d):
+    """Only an undegraded on-chip pallas number may decide a TPU default."""
+    return (d is not None and platform(d) == "tpu"
+            and "degraded" not in d and d.get("value", 0) > 0)
+
+
 def main():
     cap = sys.argv[1]
     head = load(os.path.join(cap, "bench_1m.json"))
     if not head:
         print("no headline bench in", cap)
         return
-    hp, hv = platform(head), head["value"]
-    deg = " DEGRADED" if "degraded" in head else ""
-    print(f"headline: {hv} trees/s ({hp}{deg}) "
-          f"vs_baseline={head.get('vs_baseline')} "
-          f"link={head.get('link')}")
+    deciding = clean_tpu(head)
+    print(f"headline: {head['value']} trees/s ({platform(head)}"
+          f"{' DEGRADED' if 'degraded' in head else ''}) "
+          f"vs_baseline={head.get('vs_baseline')} link={head.get('link')}")
+    if not deciding:
+        print("headline is not a clean TPU number -> NO flip decisions "
+              "from this capture; table below is informational only")
     print()
-    print(f"{'artifact':34} {'trees/s':>9} {'vs head':>8}  verdict / action")
-    for fname, knob, action in FLIPS:
+    print(f"{'artifact':34} {'trees/s':>9} {'vs base':>8}  verdict / action")
+    for fname in COVERAGE:
+        d = load(os.path.join(cap, fname))
+        if d is None:
+            print(f"{fname:34} {'—':>9} {'—':>8}  (not captured)")
+        else:
+            print(f"{fname:34} {d['value']:>9} {'—':>8}  coverage shape, "
+                  f"platform {platform(d)}, "
+                  f"vs_baseline={d.get('vs_baseline')}"
+                  f"{' DEGRADED' if 'degraded' in d else ''}")
+    for fname, knob, action, base_name in FLIPS:
         d = load(os.path.join(cap, fname))
         if d is None:
             print(f"{fname:34} {'—':>9} {'—':>8}  (not captured)")
             continue
-        p, v = platform(d), d["value"]
-        if p != hp:
-            print(f"{fname:34} {v:>9} {'—':>8}  platform {p} != headline "
-                  f"{hp}: NOT comparable, no decision")
+        base = head if base_name is None else load(
+            os.path.join(cap, base_name))
+        flags = " DEGRADED" if "degraded" in d else ""
+        if not deciding or not clean_tpu(d) or not clean_tpu(base):
+            print(f"{fname:34} {d['value']:>9} {'—':>8}  "
+                  f"platform {platform(d)}{flags}: not a clean TPU pair, "
+                  f"no decision ({knob})")
             continue
-        if fname.startswith(("bench_higgs", "bench_wide", "bench_sparse.")):
-            print(f"{fname:34} {v:>9} {'—':>8}  coverage shape "
-                  f"(vs_baseline={d.get('vs_baseline')})")
-            continue
-        ratio = v / hv if hv else float("inf")
+        ratio = d["value"] / base["value"]
         verdict = ("WIN" if ratio >= 1.05
                    else "LOSE" if ratio <= 0.95 else "NOISE")
-        print(f"{fname:34} {v:>9} {ratio:>8.3f}  {verdict}: {knob}")
+        print(f"{fname:34} {d['value']:>9} {ratio:>8.3f}  {verdict}: {knob}")
         if verdict == "WIN":
             print(f"{'':53}-> {action}")
     mp = load(os.path.join(cap, "microprobe.json"))
