@@ -75,3 +75,36 @@ def test_grow_partition_compact_ordered_identical():
     got = lgb.train(dict(base, partition_impl="compact", ordered_bins="on"),
                     lgb.Dataset(X, label=y), num_boost_round=4)
     assert ref.model_to_string() == got.model_to_string()
+
+
+def test_compact_randomized_sweep():
+    """Seeded sweep over window size, valid-prefix length, left fraction
+    (incl. all-left / all-right / empty edges) and payload count — every
+    case must match the stable-partition oracle exactly."""
+    rng = np.random.RandomState(99)
+    for trial in range(25):
+        size = 512 * rng.randint(1, 5)
+        cnt = int(rng.choice([0, 1, size, size - 1,
+                              rng.randint(1, size + 1)]))
+        frac = float(rng.choice([0.0, 1.0, rng.rand()]))
+        npay = rng.randint(0, 4)
+        win = rng.randint(0, 1 << 24, size).astype(np.int32)
+        valid = np.arange(size) < cnt
+        gl = (rng.rand(size) < frac) & valid
+        pay = [rng.randint(0, 1 << 32, size,
+                           dtype=np.uint64).astype(np.uint32)
+               for _ in range(npay)]
+        nw, np_out, nl = compact_window(
+            jnp.asarray(win), jnp.asarray(gl), jnp.asarray(valid),
+            tuple(jnp.asarray(p) for p in pay), interpret=True)
+        assert int(nl) == int(gl.sum())
+        order = np.concatenate([np.flatnonzero(gl),
+                                np.flatnonzero(valid & ~gl)])
+        exp = win.copy()
+        exp[:cnt] = win[order]
+        msg = f"trial={trial} size={size} cnt={cnt} frac={frac} npay={npay}"
+        np.testing.assert_array_equal(np.asarray(nw), exp, err_msg=msg)
+        for p, po in zip(pay, np_out):
+            ep = p.copy()
+            ep[:cnt] = p[order]
+            np.testing.assert_array_equal(np.asarray(po), ep, err_msg=msg)
