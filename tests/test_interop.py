@@ -616,19 +616,27 @@ def test_xentlambda_metric_value_parity(ref_bin, tmp_path):
         assert abs(ours - ref_val) < 1e-5, (obj, ours, ref_val)
 
 
-def test_perf_knob_matrix_training_parity(ref_bin, tmp_path):
-    """The round-4 data-movement knobs (leaf-ordered matrix, payload-sort
-    partition, pow15 buckets, word gathers forced on) are bit-neutral all
-    the way to the reference: a model trained with every knob engaged
-    predicts within the oracle envelope of the reference CLI's."""
+@pytest.mark.parametrize("knobs", [
+    # leaf-ordered matrix + Pallas compaction partition (ordered mode
+    # forces the gather path off, so words/panel are covered separately)
+    {"ordered_bins": "on", "partition_impl": "compact",
+     "bucket_scheme": "pow15"},
+    # word gathers + weight panel + payload-sort partition
+    {"gather_words": "on", "gather_panel": "on", "partition_impl": "sort",
+     "bucket_scheme": "pow15"},
+])
+def test_perf_knob_matrix_training_parity(ref_bin, tmp_path, knobs):
+    """The round-4/5 data-movement knobs (leaf-ordered matrix, Pallas
+    compaction partition, pow15 buckets, word gathers + weight panel)
+    are bit-neutral all the way to the reference: a model trained with
+    the knobs engaged predicts within the oracle envelope of the
+    reference CLI's."""
     data_path = "/root/reference/examples/binary_classification/binary.train"
     if not os.path.exists(data_path):
         pytest.skip("reference example data missing")
     ours = lgb.train({"objective": "binary", "num_leaves": 15,
                       "min_data_in_leaf": 20, "verbose": -1,
-                      "ordered_bins": "on", "partition_impl": "sort",
-                      "bucket_scheme": "pow15", "gather_words": "on",
-                      "enable_bin_packing": False},
+                      "enable_bin_packing": False, **knobs},
                      lgb.Dataset(data_path), num_boost_round=6)
     model_path = tmp_path / "knobs_ref.txt"
     conf = tmp_path / "knobs.conf"
