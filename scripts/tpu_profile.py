@@ -57,8 +57,8 @@ def train_tps(X, y, n_timed=10, **extra_params):
         ds = construct(X, cfg, label=y)
     else:
         from bench import _construct_cached
-        ds = _construct_cached(X, y, cfg, X.shape[0], X.shape[1], 0.0,
-                               params)
+        ds = _construct_cached(lambda: (X, y), cfg, X.shape[0], X.shape[1],
+                               0.0, params)
     bst = create_boosting(cfg, ds, create_objective(cfg))
     t0 = time.perf_counter()
     bst.train_one_iter()
