@@ -11,19 +11,21 @@ matmuls on the MXU plus manually-sequenced dynamic-offset DMA writes —
 all sequential HBM traffic, projected ~5 ns/row.
 
 Shape contract: the window is a [size, CP] f32 matrix (size % 512 == 0)
-whose columns are [left_mask, right_mask, order, *payload_halves]; every
-value must be exactly representable in f32 (masks 0/1, order < 2**24, u32
-payload split into u16 halves by :func:`compact_window`, which the
-grower's ``partition_branch`` drives with the same packed-word/bitcast
-payload marshalling the sort path uses).
+whose columns are [left_mask, right_mask, rank_left, rank_right, order,
+*payload_halves]; every value must be exactly representable in f32
+(masks 0/1, block-local ranks < 512, order < 2**24, u32 payload split
+into u16 halves by :func:`compact_window`, which the grower's
+``partition_branch`` drives with the same packed-word/bitcast payload
+marshalling the sort path uses).  The stable ranks are precomputed in
+XLA so the kernel body is pure compare + matmul + DMA.
 
 Algorithm (grid = (2 phases, size/512 blocks), sequential on TPU):
 
 * XLA pre-pass computes per-(phase, block) output BASES: exclusive cumsum
   of per-block left counts; right bases offset by the total left count.
   Bases ride in as scalar prefetch.
-* Each grid step loads its [512, CP] block, stable-ranks the phase's side
-  with one in-kernel cumsum, applies the rank as a [512, 512] one-hot
+* Each grid step loads its [512, CP] block, reads the phase's
+  precomputed stable rank column, applies it as a [512, 512] one-hot
   permutation matmul (stability = cumsum order; exactness = one nonzero
   per output row in f32), and DMAs the full 512-row result to
   ``out[base : base+512]``.
@@ -55,15 +57,21 @@ def _compact_kernel(bases_ref, blk_ref, out_ref, scratch, sem):
     nb = pl.num_programs(1)
     blk = blk_ref[...]                                   # [BLK, CP]
     mask = jnp.where(p == 0, blk[:, 0], blk[:, 1])       # [BLK] 0/1 f32
-    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1        # stable local rank
+    # block-local stable ranks are PRECOMPUTED in XLA and ride as columns
+    # 2/3 — the kernel body is pure compare + dot + DMA, with no in-kernel
+    # scan to lower (one less Mosaic surface; round-2 lesson)
+    rank = jnp.where(p == 0, blk[:, 2], blk[:, 3]).astype(jnp.int32)
     # one-hot permutation: P[o, i] = (rank[i] == o) & mask[i]
     onehot = ((rank[None, :] ==
                lax.broadcasted_iota(jnp.int32, (BLK, BLK), 0))
               & (mask[None, :] > 0)).astype(jnp.float32)
-    # HIGHEST pins the MXU to true-f32 contraction: the default precision
-    # may run bf16 passes, which would truncate order ids > 2^16 and
-    # payload halves — exactness, not speed, is the contract here
-    scratch[...] = jnp.dot(onehot, blk,
+    # only the DATA columns (4:) are permuted and written out — the mask
+    # and rank columns are kernel inputs nobody reads back, and writing
+    # them would be dead HBM traffic.  HIGHEST pins the MXU to true-f32
+    # contraction: the default precision may run bf16 passes, which would
+    # truncate order ids > 2^16 and payload halves — exactness, not
+    # speed, is the contract here
+    scratch[...] = jnp.dot(onehot, blk[:, 4:],
                            preferred_element_type=jnp.float32,
                            precision=lax.Precision.HIGHEST)
     base = bases_ref[p * nb + k]
@@ -77,12 +85,14 @@ def _compact_kernel(bases_ref, blk_ref, out_ref, scratch, sem):
 
 def compact_pallas(mat: jnp.ndarray, bases: jnp.ndarray,
                    interpret: bool = False) -> jnp.ndarray:
-    """mat: [size, CP] f32 (cols = [lmask, rmask, order, ...payload]);
-    bases: [2 * size/512] i32 output row offsets per (phase, block).
-    Returns [size + 512, CP] f32 — caller slices [:size] and merges tails.
+    """mat: [size, CP] f32 with columns [left_mask, right_mask, rank_left,
+    rank_right, *data] (data = order + payload halves); bases:
+    [2 * size/512] i32 output row offsets per (phase, block).
+    Returns [size + 512, CP - 4] f32 — the permuted DATA columns only;
+    caller slices [:size] and merges tails.
     """
     size, cp = mat.shape
-    assert size % BLK == 0, (size, cp)
+    assert size % BLK == 0 and cp > 4, (size, cp)
     nb = size // BLK
     return pl.pallas_call(
         _compact_kernel,
@@ -91,10 +101,10 @@ def compact_pallas(mat: jnp.ndarray, bases: jnp.ndarray,
             grid=(2, nb),
             in_specs=[pl.BlockSpec((BLK, cp), lambda p, k, bases: (k, 0))],
             out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            scratch_shapes=[pltpu.VMEM((BLK, cp), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((BLK, cp - 4), jnp.float32),
                             pltpu.SemaphoreType.DMA],
         ),
-        out_shape=jax.ShapeDtypeStruct((size + BLK, cp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((size + BLK, cp - 4), jnp.float32),
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
@@ -121,15 +131,6 @@ def compact_window(win: jnp.ndarray, goes_left: jnp.ndarray,
     gr = valid & ~goes_left
     glf = gl.astype(jnp.float32)
     grf = gr.astype(jnp.float32)
-    cols = [glf, grf, win.astype(jnp.float32)]
-    for c in payload_u32:
-        cu = c.astype(jnp.uint32)
-        cols.append((cu & 0xffff).astype(jnp.float32))
-        cols.append((cu >> 16).astype(jnp.float32))
-    # no lane padding: the MXU pads the dot's lane dim internally either
-    # way, but refs and DMAs carry only the real columns — padding to 128
-    # would amplify the HBM write traffic up to 40x for small payloads
-    mat = jnp.stack(cols, axis=1)
     # per-(phase, block) output bases: lefts pack from 0, rights from nl
     nb = size // BLK
     lcnt = glf.reshape(nb, BLK).sum(axis=1).astype(jnp.int32)
@@ -138,12 +139,32 @@ def compact_window(win: jnp.ndarray, goes_left: jnp.ndarray,
     lbase = jnp.cumsum(lcnt) - lcnt
     rbase = nl + jnp.cumsum(rcnt) - rcnt
     bases = jnp.concatenate([lbase, rbase])
+    # block-local stable ranks, precomputed here so the kernel has no
+    # in-kernel scan: global inclusive cumsum minus the block's exclusive
+    # prefix, minus 1 (values < 512, f32-exact; garbage on non-side rows
+    # is masked by the kernel's mask columns)
+    # int32 cumsum: exact at any window size (an f32 running sum would
+    # round past 2^24 rows and silently collide two output rows)
+    csl = jnp.cumsum(gl.astype(jnp.int32))
+    csr = jnp.cumsum(gr.astype(jnp.int32))
+    rank_l = csl - jnp.repeat(lbase, BLK) - 1
+    rank_r = csr - jnp.repeat(rbase - nl, BLK) - 1
+    cols = [glf, grf, rank_l.astype(jnp.float32),
+            rank_r.astype(jnp.float32), win.astype(jnp.float32)]
+    for c in payload_u32:
+        cu = c.astype(jnp.uint32)
+        cols.append((cu & 0xffff).astype(jnp.float32))
+        cols.append((cu >> 16).astype(jnp.float32))
+    # no lane padding: the MXU pads the dot's lane dim internally either
+    # way, but refs and DMAs carry only the real columns — padding to 128
+    # would amplify the HBM write traffic up to 40x for small payloads
+    mat = jnp.stack(cols, axis=1)
     out = compact_pallas(mat, bases, interpret=interpret)[:size]
-    new_win = jnp.where(valid, out[:, 2].astype(jnp.int32), win)
+    new_win = jnp.where(valid, out[:, 0].astype(jnp.int32), win)
     new_payload = []
     for i in range(len(payload_u32)):
-        lo = out[:, 3 + 2 * i].astype(jnp.uint32)
-        hi = out[:, 4 + 2 * i].astype(jnp.uint32)
+        lo = out[:, 1 + 2 * i].astype(jnp.uint32)
+        hi = out[:, 2 + 2 * i].astype(jnp.uint32)
         merged = lo | (hi << 16)
         new_payload.append(jnp.where(valid, merged,
                                      payload_u32[i].astype(jnp.uint32)))

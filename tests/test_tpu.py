@@ -194,7 +194,7 @@ def test_pallas_nibble_matches_onehot_on_device(tpu):
 def test_pallas_compact_compiles_and_matches_on_tpu(tpu):
     """Mosaic lowering proof for the compaction-partition kernel — the
     riskiest surface (dynamic-offset HBM DMA, scalar-prefetch bases,
-    in-kernel cumsum + permutation matmul).  Compiles, runs, and must
+    precomputed-rank permutation matmul).  Compiles, runs, and must
     match the stable-partition oracle exactly; prints throughput for the
     capture log (gates partition_impl=compact as a bench A/B)."""
     import sys
@@ -217,8 +217,9 @@ def test_pallas_compact_compiles_and_matches_on_tpu(tpu):
     exp = win.copy()
     exp[:cnt] = win[order]
     np.testing.assert_array_equal(np.asarray(nw), exp)
-    # the no-payload shape (cp=3, narrowest unaligned DMA width) must
-    # ALSO lower — the bench A/B without ordered_bins runs exactly this
+    # the no-payload shape (output width 1, the narrowest unaligned DMA)
+    # must ALSO lower — the bench A/B without ordered_bins runs exactly
+    # this; the 8-payload case above exercises output width 17
     nw0, _, _ = jax.jit(lambda w, g, v: compact_window(w, g, v, ()))(
         jnp.asarray(win), jnp.asarray(gl), jnp.asarray(valid))
     np.testing.assert_array_equal(np.asarray(nw0), exp)
