@@ -616,16 +616,23 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
 
                 if use_compact:
                     from .ops.pallas_compact import compact_window
+                    # interpret tracks the COMPILE TARGET, not the host
+                    # backend: hist_method=="pallas" means this program is
+                    # being lowered for a real TPU (incl. AOT lowering
+                    # from a CPU host, tests/test_mosaic_aot.py) and the
+                    # kernel must go through Mosaic; anything else is the
+                    # CPU/interpret path
+                    interp = cfg.hist_method != "pallas"
                     if use_ordered:
                         payload, info = payload_cols()
                         new_win, newpay, nl = compact_window(
                             win, goes_left, valid, payload,
-                            interpret=not on_tpu())
+                            interpret=interp)
                         obins, ow = payload_store(obins, ow, newpay, info)
                     else:
                         new_win, _, nl = compact_window(
                             win, goes_left, valid, (),
-                            interpret=not on_tpu())
+                            interpret=interp)
                     order = lax.dynamic_update_slice(order, new_win, (start,))
                     return order, obins, ow, nl
                 if use_sort:

@@ -49,6 +49,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 BLK = 512       # rows per block; every gather-bucket size divides it
+LANES = 128     # output DMA width must be a multiple of this (Mosaic)
 
 
 def _compact_kernel(bases_ref, blk_ref, out_ref, scratch, sem):
@@ -66,12 +67,21 @@ def _compact_kernel(bases_ref, blk_ref, out_ref, scratch, sem):
                lax.broadcasted_iota(jnp.int32, (BLK, BLK), 0))
               & (mask[None, :] > 0)).astype(jnp.float32)
     # only the DATA columns (4:) are permuted and written out — the mask
-    # and rank columns are kernel inputs nobody reads back, and writing
-    # them would be dead HBM traffic.  HIGHEST pins the MXU to true-f32
-    # contraction: the default precision may run bf16 passes, which would
-    # truncate order ids > 2^16 and payload halves — exactness, not
-    # speed, is the contract here
-    scratch[...] = jnp.dot(onehot, blk[:, 4:],
+    # and rank columns are kernel inputs nobody reads back.  The output
+    # width is zero-padded to a 128-lane multiple IN the kernel: Mosaic
+    # rejects HBM slices whose minor dim is not tile-aligned ("Slice
+    # shape along dimension 1 must be aligned to tiling (128)", proven
+    # via v5e AOT compile), so the narrower no-pad form cannot lower.
+    # HIGHEST pins the MXU to true-f32 contraction: the default precision
+    # may run bf16 passes, which would truncate order ids > 2^16 and
+    # payload halves — exactness, not speed, is the contract here
+    data = blk[:, 4:]
+    out_w = scratch.shape[1]
+    if data.shape[1] < out_w:
+        data = jnp.concatenate(
+            [data, jnp.zeros((BLK, out_w - data.shape[1]), data.dtype)],
+            axis=1)
+    scratch[...] = jnp.dot(onehot, data,
                            preferred_element_type=jnp.float32,
                            precision=lax.Precision.HIGHEST)
     base = bases_ref[p * nb + k]
@@ -88,11 +98,14 @@ def compact_pallas(mat: jnp.ndarray, bases: jnp.ndarray,
     """mat: [size, CP] f32 with columns [left_mask, right_mask, rank_left,
     rank_right, *data] (data = order + payload halves); bases:
     [2 * size/512] i32 output row offsets per (phase, block).
-    Returns [size + 512, CP - 4] f32 — the permuted DATA columns only;
-    caller slices [:size] and merges tails.
+    Returns [size + 512, ceil((CP-4)/128)*128] f32 — the permuted DATA
+    columns, zero-padded to a lane-aligned width (a Mosaic DMA
+    requirement); caller slices [:size] rows, reads the first CP-4
+    columns, and merges tails.
     """
     size, cp = mat.shape
     assert size % BLK == 0 and cp > 4, (size, cp)
+    out_w = -(-(cp - 4) // LANES) * LANES
     nb = size // BLK
     return pl.pallas_call(
         _compact_kernel,
@@ -101,10 +114,10 @@ def compact_pallas(mat: jnp.ndarray, bases: jnp.ndarray,
             grid=(2, nb),
             in_specs=[pl.BlockSpec((BLK, cp), lambda p, k, bases: (k, 0))],
             out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            scratch_shapes=[pltpu.VMEM((BLK, cp - 4), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((BLK, out_w), jnp.float32),
                             pltpu.SemaphoreType.DMA],
         ),
-        out_shape=jax.ShapeDtypeStruct((size + BLK, cp - 4), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((size + BLK, out_w), jnp.float32),
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
@@ -155,9 +168,12 @@ def compact_window(win: jnp.ndarray, goes_left: jnp.ndarray,
         cu = c.astype(jnp.uint32)
         cols.append((cu & 0xffff).astype(jnp.float32))
         cols.append((cu >> 16).astype(jnp.float32))
-    # no lane padding: the MXU pads the dot's lane dim internally either
-    # way, but refs and DMAs carry only the real columns — padding to 128
-    # would amplify the HBM write traffic up to 40x for small payloads
+    # the INPUT matrix is unpadded (BlockSpec reads are block-granular and
+    # Mosaic pads vregs internally); the OUTPUT is lane-padded to 128
+    # inside the kernel because Mosaic requires DMA slice widths aligned
+    # to the tiling — a real write-amplification cost (128 f32/row vs
+    # cp-4) that the on-chip A/B prices; it is the cost of lowering, not
+    # a choice
     mat = jnp.stack(cols, axis=1)
     out = compact_pallas(mat, bases, interpret=interpret)[:size]
     new_win = jnp.where(valid, out[:, 0].astype(jnp.int32), win)

@@ -135,11 +135,11 @@ def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
     b_pad = -(-num_bins // LANES) * LANES
     grid = (f // feat_tile, n // row_tile)
     if impl == "auto":
-        # the nibble form is the projected winner at B_pad = 256, but it
-        # has not yet compiled under Mosaic on a real chip (the round-2
-        # lesson: interpret mode cannot see lowering failures) — 'auto'
-        # stays on the hardware-proven kernel until the on-chip tier
-        # passes test_pallas_nibble_* (then flip here)
+        # the nibble form is the projected 2x winner at B_pad = 256; its
+        # Mosaic LOWERING is proven offline (tests/test_mosaic_aot.py AOT-
+        # compiles it for v5e), but 'auto' stays on the hardware-proven
+        # kernel until an on-chip A/B confirms the throughput win
+        # (bench_1m_nibble.json in the capture playbook — then flip here)
         impl = "onehot"
     if impl == "nibble" and b_pad != 2 * LANES:
         # the config gate is optimistic about bin packing widening the
