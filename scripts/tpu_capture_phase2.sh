@@ -3,8 +3,9 @@
 #   1. headline bench (the driver artifact has missed four rounds — bank it)
 #   2. microprobe (name the ~3.3 ms/split residual; VERDICT #2)
 #   3. ordered_bins+sort combined A/B (the two big structural flips at once)
-#   4. nibble Mosaic gate + bench (the 2x MXU-slot win; VERDICT #3)
-#   5. 63-bin rung (the reference's own GPU benchmark setting)
+#   4. compact-partition A/B (lowering-proven offline; biggest partition win)
+#   5. nibble Mosaic gate + bench (the 2x MXU-slot win; VERDICT #3)
+#   6. 63-bin rung + FULL Higgs 10.5M (VERDICT #4) + attribution A/Bs
 #   6. FULL Higgs 10.5M — the actual north-star shape (VERDICT #4)
 #   7. individual A/Bs to attribute the combined result
 #   8. tier / wide / sparse / profile coverage
@@ -81,6 +82,26 @@ cat "$OUT/bench_1m_ordered_sort.json" | tee -a "$OUT/log.txt"
 snap "ordered+sort A/B"
 
 alive_or_abort "ordered+sort A/B"
+echo "== compact-partition Mosaic gate + A/B bench ==" | tee -a "$OUT/log.txt"
+if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
+        "tests/test_tpu.py::test_pallas_compact_compiles_and_matches_on_tpu" \
+        -q >> "$OUT/log.txt" 2>&1; then
+    BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact \
+        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+        > "$OUT/bench_1m_compact.json" 2>> "$OUT/log.txt"
+    cat "$OUT/bench_1m_compact.json" | tee -a "$OUT/log.txt"
+    BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact,ordered_bins=on \
+        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
+        > "$OUT/bench_1m_compact_ordered.json" 2>> "$OUT/log.txt"
+    cat "$OUT/bench_1m_compact_ordered.json" | tee -a "$OUT/log.txt"
+    snap "compact-partition A/B"
+else
+    echo "compact Mosaic gate FAILED - skipping compact bench" \
+        | tee -a "$OUT/log.txt"
+    snap "compact gate failed"
+fi
+
+alive_or_abort "compact"
 echo "== nibble kernel Mosaic gate + A/B bench ==" | tee -a "$OUT/log.txt"
 # only worth a bench slot if the Mosaic gate passes (a failed gate means
 # the same compile error would burn this stage's whole timeout)
@@ -132,26 +153,6 @@ cat "$OUT/bench_1m_sortpart.json" | tee -a "$OUT/log.txt"
 snap "sort-partition A/B"
 
 alive_or_abort "sort A/B"
-echo "== compact-partition Mosaic gate + A/B bench ==" | tee -a "$OUT/log.txt"
-if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
-        "tests/test_tpu.py::test_pallas_compact_compiles_and_matches_on_tpu" \
-        -q >> "$OUT/log.txt" 2>&1; then
-    BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact \
-        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-        > "$OUT/bench_1m_compact.json" 2>> "$OUT/log.txt"
-    cat "$OUT/bench_1m_compact.json" | tee -a "$OUT/log.txt"
-    BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact,ordered_bins=on \
-        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-        > "$OUT/bench_1m_compact_ordered.json" 2>> "$OUT/log.txt"
-    cat "$OUT/bench_1m_compact_ordered.json" | tee -a "$OUT/log.txt"
-    snap "compact-partition A/B"
-else
-    echo "compact Mosaic gate FAILED - skipping compact bench" \
-        | tee -a "$OUT/log.txt"
-    snap "compact gate failed"
-fi
-
-alive_or_abort "compact"
 echo "== gather_words A/B (words off) ==" | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
     BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
