@@ -91,3 +91,24 @@ def test_full_grower_lowers(v5e, knobs):
     grow.lower(v5e((n, f), jnp.uint8), v5e((n,), jnp.float32),
                v5e((n,), jnp.float32), v5e((n,), jnp.float32),
                meta, v5e((f,), jnp.bool_)).compile()
+
+
+def test_full_grower_lowers_wide(v5e):
+    """Epsilon-wide (F=2000) grower Mosaic-compiles — the capture's wide
+    coverage stage cannot be lost to a lowering surprise (measured ~96 s
+    to compile on the 1-core host; budget the in-window remote compile
+    accordingly)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig, make_grower
+    n, f = 1 << 17, 2000
+    cfg = GrowerConfig(num_leaves=255, min_data_in_leaf=1,
+                       min_sum_hessian_in_leaf=100.0, max_bin=255,
+                       hist_method="pallas", gather_words="on")
+    meta = FeatureMeta(
+        num_bin=v5e((f,), jnp.int32), missing_type=v5e((f,), jnp.int32),
+        default_bin=v5e((f,), jnp.int32),
+        is_categorical=v5e((f,), jnp.bool_))
+    grow = jax.jit(make_grower(cfg))
+    grow.lower(v5e((n, f), jnp.uint8), v5e((n,), jnp.float32),
+               v5e((n,), jnp.float32), v5e((n,), jnp.float32),
+               meta, v5e((f,), jnp.bool_)).compile()
