@@ -112,3 +112,52 @@ def test_full_grower_lowers_wide(v5e):
     grow.lower(v5e((n, f), jnp.uint8), v5e((n,), jnp.float32),
                v5e((n,), jnp.float32), v5e((n,), jnp.float32),
                meta, v5e((f,), jnp.bool_)).compile()
+
+
+@pytest.mark.parametrize("learner", ["data", "voting", "feature",
+                                     "data_feature"])
+def test_distributed_grower_lowers_4chip(learner):
+    """All four distributed tree learners Mosaic-compile for a REAL
+    4-chip v5e topology — shard_map + ICI collectives (psum, argmax
+    sync, all_gather votes) through the actual TPU lowering, not the
+    CPU-mesh stand-in.  The strongest multi-chip evidence available
+    without multi-chip hardware; execution still needs a real slice."""
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig
+    from lightgbm_tpu.parallel.learner import make_distributed_grower
+    try:
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name="v5e:2x2")
+    except Exception as e:
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    devs = np.array(topo.devices)
+    cfg = GrowerConfig(num_leaves=63, min_data_in_leaf=1,
+                       min_sum_hessian_in_leaf=100.0, max_bin=255,
+                       hist_method="pallas", gather_words="on")
+    n, f = 1 << 16, 32
+    if learner == "data_feature":
+        mesh = Mesh(devs.reshape(2, 2), ("data", "feature"))
+        row_spec, bins_spec = P("data"), P("data", None)
+    else:
+        axis = "feature" if learner == "feature" else "data"
+        mesh = Mesh(devs.reshape(4), (axis,))
+        row_spec = P(axis) if learner != "feature" else P()
+        bins_spec = P(axis, None) if learner != "feature" else P()
+    fn = make_distributed_grower(cfg, mesh, learner)
+
+    def arg(shape, dtype, spec):
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=NamedSharding(mesh, spec))
+    meta = FeatureMeta(
+        num_bin=arg((f,), jnp.int32, P()),
+        missing_type=arg((f,), jnp.int32, P()),
+        default_bin=arg((f,), jnp.int32, P()),
+        is_categorical=arg((f,), jnp.bool_, P()))
+    fn.lower(arg((n, f), jnp.uint8, bins_spec),
+             arg((n,), jnp.float32, row_spec),
+             arg((n,), jnp.float32, row_spec),
+             arg((n,), jnp.float32, row_spec),
+             meta, arg((f,), jnp.bool_, P())).compile()
