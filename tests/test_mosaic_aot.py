@@ -161,3 +161,31 @@ def test_distributed_grower_lowers_4chip(learner):
              arg((n,), jnp.float32, row_spec),
              arg((n,), jnp.float32, row_spec),
              meta, arg((f,), jnp.bool_, P())).compile()
+
+
+def test_packed_grower_lowers(v5e):
+    """The bin-packing composition (packed storage matrix + joint 256-bin
+    Pallas histograms + unfold) Mosaic-compiles — the sparse capture
+    stage's exact on-chip path."""
+    import numpy as np
+    import jax.numpy as jnp
+    from lightgbm_tpu.data.packing import build_pack_plan
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig, make_grower
+    f = 24
+    col_bins = [255, 255] + [9] * (f - 2)        # 2 wide + 22 narrow cols
+    plan = build_pack_plan(col_bins)
+    assert plan is not None and plan.num_packed >= 20
+    n = 1 << 16
+    cfg = GrowerConfig(num_leaves=63, min_data_in_leaf=1,
+                       min_sum_hessian_in_leaf=100.0, max_bin=255,
+                       hist_method="pallas", gather_words="on")
+    meta = FeatureMeta(
+        num_bin=v5e((f,), jnp.int32), missing_type=v5e((f,), jnp.int32),
+        default_bin=v5e((f,), jnp.int32),
+        is_categorical=v5e((f,), jnp.bool_))
+    grow = jax.jit(make_grower(cfg, pack_plan=plan))
+    grow.lower(v5e((n, f), jnp.uint8),
+               v5e((n, plan.num_storage_cols), jnp.uint8),
+               v5e((n,), jnp.float32), v5e((n,), jnp.float32),
+               v5e((n,), jnp.float32), meta,
+               v5e((f,), jnp.bool_)).compile()
