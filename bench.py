@@ -30,13 +30,16 @@ interop-built reference CLI).  ``vs_baseline`` = our trees/s / that.
 Robustness: this process is a thin SUPERVISOR — the measured workload runs
 in a child subprocess (BENCH_CHILD=1) so a hung TPU tunnel or a Mosaic
 compile failure can never take down the bench.  A fallback ladder
-  (1) tpu + pallas histogram kernel
-  (2) tpu + einsum histograms        (Pallas compile failure)
-  (3) cpu + segment_sum histograms   (TPU unreachable / hung)
+  (1) tpu + fused  (gen-2 in-kernel-gather histogram kernel)
+  (2) tpu + pallas (gen-1 one-hot kernel — the hardware-proven rung)
+  (3) tpu + einsum histograms        (Pallas compile failure)
+  (4) cpu + segment_sum histograms   (TPU unreachable / hung)
 is walked until a child prints a result line; the final JSON always appears
 on stdout, with a "degraded" field naming any fallback taken (round-1
 failure was an unreachable TPU plugin; round-2 was a Mosaic compile error
 *after* backend init — both are now survivable by construction).
+BENCH_FUSED=0 drops the fused rung — the capture playbook's forced-gen-1
+A/B (bench_1m_gen1.json) against the default ladder's headline.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"[, "degraded"]}.
 """
@@ -55,6 +58,19 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
 BASELINE_TREES_PER_SEC_1M = 2.5285 * 28  # see module docstring
+
+# only binning-relevant params key the dataset cache: grower knobs
+# (gather_*, partition_impl, ordered_bins, bin packing, pallas_fused, ...)
+# never change the constructed dataset, and hashing them would make every
+# A/B stage re-bin during a live tunnel window.  INVARIANT (pinned by
+# tests/test_bench_keys.py): this set must stay a superset of every
+# construction-relevant Config attribute read under lightgbm_tpu/data/ —
+# a new construction knob missing here would silently reuse stale cached
+# datasets in A/B runs.
+BINNING_KEYS = frozenset({
+    "enable_bundle", "max_bin", "min_data_in_bin", "use_missing",
+    "zero_as_missing", "bin_construct_sample_cnt", "max_conflict_rate",
+    "min_data_in_leaf", "data_random_seed"})
 
 
 def make_data(n, f=28, sparsity=0.0, seed=42):
@@ -109,23 +125,16 @@ def _construct_cached(make_xy, cfg, n_rows, n_feat, sparsity, params):
         return construct(X, cfg, label=y)
     import hashlib
     from lightgbm_tpu.config import canonicalize_params
-    # only binning-relevant extras key the cache: grower knobs (gather_*,
-    # partition_impl, ordered_bins, bin packing, ...) never change the
-    # constructed dataset, and hashing them would make every A/B stage
-    # re-bin during a live tunnel window.  Keys are canonicalized first so
-    # aliases/case/whitespace neither miss the filter nor alias a stale
-    # entry.  The set mirrors what lightgbm_tpu/data/ actually reads at
+    # keys are canonicalized first so aliases/case/whitespace neither miss
+    # the BINNING_KEYS filter nor alias a stale entry; the set itself
+    # (module constant) mirrors what lightgbm_tpu/data/ actually reads at
     # construction (incl. min_data_in_leaf's trivial-feature pre-filter
-    # and the bin-sample seed).
-    binning_keys = {"enable_bundle", "max_bin", "min_data_in_bin",
-                    "use_missing", "zero_as_missing",
-                    "bin_construct_sample_cnt", "max_conflict_rate",
-                    "min_data_in_leaf", "data_random_seed"}
+    # and the bin-sample seed) and is invariant-checked in CI.
     raw = dict(kv.partition("=")[::2] for kv in filter(
         None, os.environ.get("BENCH_EXTRA_PARAMS", "").split(",")))
     canon = canonicalize_params(raw)
     extras = ",".join(f"{k}={v}" for k, v in sorted(canon.items())
-                      if k in binning_keys)
+                      if k in BINNING_KEYS)
     xh = hashlib.md5(extras.encode()).hexdigest()[:8] if extras else "0"
     # version salt: a binning-code change must invalidate cached datasets,
     # or the bench would attribute stale-bin numbers to the code under test
@@ -165,7 +174,9 @@ def child_main():
     """The measured workload.  Runs under BENCH_CHILD with the platform and
     histogram method fixed by the supervisor; prints the result JSON line."""
     platform_want = os.environ["BENCH_CHILD_PLATFORM"]      # 'tpu' | 'cpu'
-    use_pallas = os.environ["BENCH_CHILD_PALLAS"] == "1"
+    mode = os.environ.get("BENCH_CHILD_MODE", "segment")
+    #                      fused | pallas | einsum | segment (cpu)
+    use_pallas = mode in ("fused", "pallas")
     if platform_want == "cpu":
         os.environ["PALLAS_AXON_POOL_IPS"] = ""             # skip axon plugin
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -210,6 +221,8 @@ def child_main():
         "learning_rate": 0.1,
         "verbose": -1,
         "use_pallas": use_pallas and platform == "tpu",
+        "pallas_fused": "on" if mode == "fused" and platform == "tpu"
+                        else "auto",
         "enable_bundle": sparsity > 0.0,
     }
     # ad-hoc A/B knobs (e.g. BENCH_EXTRA_PARAMS=enable_bin_packing=false)
@@ -241,6 +254,13 @@ def child_main():
     link = _link_profile(jax)
     sys.stderr.write(f"bench: link {json.dumps(link)}\n")
 
+    # label from the grower's RESOLVED method, not the requested mode: a
+    # fused request that fell back (layout gate) must never be recorded
+    # as a fused number
+    resolved = booster.grower_cfg.hist_method
+    kernel_tag = (f", {resolved}" if platform == "tpu"
+                  and resolved in ("fused", "pallas") else "")
+
     if "BENCH_BASELINE_TPS" in os.environ:
         # an externally measured baseline is tied to the shape it was
         # measured at (BENCH_BASELINE_ROWS, default: the requested
@@ -255,8 +275,7 @@ def child_main():
     print(json.dumps({
         "metric": f"higgs-like {n_rows // 1000}k x{n_feat} binary GBDT "
                   f"training throughput, {params['num_leaves']} leaves, "
-                  f"{params['max_bin']} bins ({platform}"
-                  f"{', pallas' if params['use_pallas'] else ''}"
+                  f"{params['max_bin']} bins ({platform}{kernel_tag}"
                   f"{f', sparsity={sparsity}' if sparsity else ''})",
         "value": round(trees_per_sec, 4),
         "unit": "trees/sec",
@@ -297,13 +316,21 @@ def _link_profile(jax):
         return {"error": str(e)[:120]}
 
 
-def _run_child(platform: str, pallas: bool, timeout_s: int):
+def _rung_label(platform: str, mode: str) -> str:
+    """Human label for a ladder rung: tpu+fused / tpu+pallas / tpu (einsum)
+    / cpu — the tpu/cpu spellings predate the fused rung and are kept so
+    degradation strings stay comparable across rounds."""
+    return f"{platform}+{mode}" if mode in ("fused", "pallas") else platform
+
+
+def _run_child(platform: str, mode: str, timeout_s: int):
     """One rung of the fallback ladder.  Returns the parsed JSON dict or an
     error string."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CHILD_PLATFORM"] = platform
-    env["BENCH_CHILD_PALLAS"] = "1" if pallas else "0"
+    env["BENCH_CHILD_MODE"] = mode
+    label = _rung_label(platform, mode)
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            capture_output=True, text=True, timeout=timeout_s,
@@ -315,8 +342,7 @@ def _run_child(platform: str, pallas: bool, timeout_s: int):
                 "utf-8", "replace")
             sys.stderr.write(err[-4000:])
             tail = " last stderr: " + err.strip()[-200:].replace("\n", " | ")
-        return (f"{platform}{'+pallas' if pallas else ''}: "
-                f"timeout {timeout_s}s{tail}")
+        return f"{label}: timeout {timeout_s}s{tail}"
     sys.stderr.write(r.stderr[-4000:])
     if r.returncode == 0:
         for line in reversed(r.stdout.strip().splitlines()):
@@ -327,7 +353,7 @@ def _run_child(platform: str, pallas: bool, timeout_s: int):
                 except json.JSONDecodeError:
                     break
     tail = (r.stderr or r.stdout).strip()[-300:].replace("\n", " | ")
-    return f"{platform}{'+pallas' if pallas else ''}: rc={r.returncode} {tail}"
+    return f"{label}: rc={r.returncode} {tail}"
 
 
 def _tpu_reachable(timeout_s: int) -> bool:
@@ -382,14 +408,18 @@ def main():
     timeout_s = int(os.environ.get("BENCH_STAGE_TIMEOUT", 3600))
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
     want = os.environ.get("BENCH_PLATFORM")  # force 'cpu' or 'tpu'
-    ladder = [("tpu", True), ("tpu", False), ("cpu", False)]
+    ladder = [("tpu", "fused"), ("tpu", "pallas"), ("tpu", "einsum"),
+              ("cpu", "segment")]
     if want == "cpu":
-        ladder = [("cpu", False)]
+        ladder = [("cpu", "segment")]
     elif want == "tpu":
-        ladder = [("tpu", True), ("tpu", False)]
+        ladder = [("tpu", "fused"), ("tpu", "pallas"), ("tpu", "einsum")]
+    if os.environ.get("BENCH_FUSED") == "0":
+        # the capture playbook's forced-gen-1 A/B partner (bench_1m_gen1)
+        ladder = [r for r in ladder if r[1] != "fused"]
     if ladder[0][0] == "tpu" and not _tpu_reachable(probe_timeout):
         sys.stderr.write("bench: tpu unreachable, skipping tpu rungs\n")
-        dropped = " ; ".join(f"{p}{'+pallas' if q else ''}: skipped, tpu "
+        dropped = " ; ".join(f"{_rung_label(p, q)}: skipped, tpu "
                              "probe failed" for p, q in ladder if p == "tpu")
         ladder = [r for r in ladder if r[0] != "tpu"]
         if not ladder:   # BENCH_PLATFORM=tpu forced but unreachable
@@ -404,12 +434,12 @@ def main():
     errors = []
     if os.environ.get("BENCH_TPU_SKIPPED"):
         errors.append(os.environ["BENCH_TPU_SKIPPED"])
-    for i, (platform, pallas) in enumerate(ladder):
-        res = _run_child(platform, pallas, timeout_s)
+    for i, (platform, mode) in enumerate(ladder):
+        res = _run_child(platform, mode, timeout_s)
         if isinstance(res, dict):
             if errors:
                 res["degraded"] = ("fell back to "
-                                   f"{platform}{'+pallas' if pallas else ''}: "
+                                   f"{_rung_label(platform, mode)}: "
                                    + " ; ".join(errors))
                 _attach_last_tpu_capture(res)
             print(json.dumps(res))

@@ -200,7 +200,14 @@ class GBDT:
             lambda_l2=cfg.lambda_l2,
             min_gain_to_split=cfg.min_gain_to_split,
             max_bin=train.max_num_bin(),
-            hist_method=("pallas" if cfg.use_pallas and _on_tpu()
+            # fused (gen-2, in-kernel gather) sits above pallas on the TPU
+            # rung ladder but stays OPT-IN (pallas_fused=on) while 'auto'
+            # resolves to the hardware-proven gen-1 kernel — the same
+            # discipline as the nibble impl's 'auto'; the bench ladder's
+            # tpu+fused rung is the A/B that flips this default
+            hist_method=("fused" if cfg.use_pallas and _on_tpu()
+                         and cfg.pallas_fused == "on"
+                         else "pallas" if cfg.use_pallas and _on_tpu()
                          else "einsum" if _on_tpu()   # MXU-friendly debug
                          else cfg.cpu_hist_method),   # scatter-add on CPU
             feat_tile=cfg.pallas_feat_tile,
@@ -303,6 +310,26 @@ class GBDT:
                          self._pack_plan.num_packed,
                          self._pack_plan.num_phys_cols,
                          self._pack_plan.num_storage_cols)
+        # fused-rung truthfulness: downgrade a fused request the layout
+        # cannot serve HERE, so grower_cfg.hist_method (which bench labels
+        # and A/B artifacts read) always names the kernel that runs; the
+        # grower re-checks the same gate at trace time as a safety net
+        if self.grower_cfg.hist_method == "fused":
+            from .data.packing import PACK_JOINT_BINS
+            from .grower import fused_gate_reason
+            plan = self._pack_plan
+            hw = (max(PACK_JOINT_BINS, self.grower_cfg.max_bin)
+                  if plan is not None else self.grower_cfg.max_bin)
+            ncols = (plan.num_storage_cols if plan is not None
+                     else train.binned.shape[1])
+            reason = fused_gate_reason(
+                train.binned.dtype, jnp.float32, hw, ncols,
+                self.grower_cfg.ordered_bins == "on" and plan is None)
+            if reason is not None:
+                log.warning("pallas_fused=on unavailable (%s); using the "
+                            "gen-1 pallas kernel", reason)
+                self.grower_cfg = self.grower_cfg._replace(
+                    hist_method="pallas")
         # the bagged-subset optimization (gbdt.cpp:323-382 is_use_subset_)
         # gathers rows into a compact matrix — serial learner only for now
         self._can_subset = not use_dist

@@ -233,6 +233,12 @@ class Config:
                                    # word matrix so each split's read is
                                    # ONE row gather: auto | on | off
     pallas_hist_impl: str = "auto"  # kernel form: auto | onehot | nibble
+    pallas_fused: str = "auto"     # gen-2 fused-gather nibble histogram
+                                   # kernel (in-kernel row DMA, no gather
+                                   # pass, no pow2 staging buffer):
+                                   # auto | on | off; 'auto' stays on the
+                                   # hardware-proven gen-1 kernel until
+                                   # the on-chip A/B flips it
     ordered_bins: str = "auto"     # leaf-ordered bin matrix (OrderedBin
                                    # analogue): auto | on | off; 'on' trades
                                    # wide partition scatters for contiguous
@@ -401,6 +407,9 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.pallas_hist_impl not in ("auto", "onehot", "nibble"):
         log.fatal("pallas_hist_impl must be auto, onehot, or nibble; got %r",
                   cfg.pallas_hist_impl)
+    if cfg.pallas_fused not in ("auto", "on", "off"):
+        log.fatal("pallas_fused must be auto, on, or off; got %r",
+                  cfg.pallas_fused)
     if cfg.ordered_bins not in ("auto", "on", "off"):
         log.fatal("ordered_bins must be auto, on, or off; got %r",
                   cfg.ordered_bins)

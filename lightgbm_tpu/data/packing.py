@@ -31,6 +31,81 @@ import numpy as np
 
 PACK_MAX_BIN = 16          # nibble capacity
 PACK_JOINT_BINS = 256      # joint (lo, hi) index space
+FUSED_COL_GROUP = 8        # fused-kernel feature-group width (8 * 16 lanes)
+
+
+def pack_gather_words(mat):
+    """[N, C] uint8/uint16 -> ([N, W] uint32, lanes_per_word).
+
+    On TPU a random row gather costs per ELEMENT, not per byte (measured
+    ~12.6 ns/elem on v5e through XLA's gather); packing 4 uint8 (or 2
+    uint16) bin columns into each uint32 word cuts the gathered element
+    count 4x (2x), and the unpack after the gather is a handful of
+    shift/mask vector ops that XLA fuses into the consumer.  The same
+    word layout is what the gen-2 fused histogram kernel's in-kernel row
+    DMA reads (ops/pallas_hist.hist6_fused)."""
+    import jax.numpy as jnp
+    n, c = mat.shape
+    assert mat.dtype.itemsize <= 2, mat.dtype   # u32 words hold 4 u8 or 2 u16
+    per = 4 if mat.dtype.itemsize == 1 else 2
+    w = -(-c // per)
+    m = jnp.pad(mat, ((0, 0), (0, w * per - c))).astype(jnp.uint32)
+    m = m.reshape(n, w, per)
+    packed = m[:, :, 0]
+    for k in range(1, per):
+        packed = packed | (m[:, :, k] << (k * (32 // per)))
+    return packed, per
+
+
+def unpack_gather_words(words, c: int, per: int):
+    """[M, W] uint32 -> [M, C] int32 (inverse of :func:`pack_gather_words`)."""
+    import jax.numpy as jnp
+    shift = 32 // per
+    mask = jnp.uint32((1 << shift) - 1)
+    parts = [(words >> (k * shift)) & mask for k in range(per)]
+    stacked = jnp.stack(parts, axis=-1).reshape(words.shape[0], -1)
+    return stacked[:, :c].astype(jnp.int32)
+
+
+FUSED_PANEL_LANES = 128    # panel minor dim is padded to this multiple:
+#                            Mosaic DMA row slices must span whole 128-lane
+#                            tiles, so each in-kernel row gather is one
+#                            aligned [1, 128k]-u32 burst (512 B — the HBM
+#                            transaction class a random row read touches
+#                            regardless of how few bytes it keeps)
+
+
+def pack_fused_panel(bins_pad, gw_pad, hw_pad, cw_pad):
+    """The u32 row layout the gen-2 fused histogram kernel DMAs per row:
+    [N(+1), C] uint8/uint16 bins + three f32 weight columns ->
+    ([N(+1), ceil((W + 3) / 128) * 128] uint32, lanes_per_word).
+
+    Columns are zero-padded up to a FUSED_COL_GROUP multiple BEFORE word
+    packing so the kernel's phantom features (its feature loop runs in
+    groups of 8) always read real, provably-zero words; the f32 weights
+    ride as bitcast u32 columns after the words (pure bitcasts — values
+    are bit-identical through the panel); the whole row is then padded to
+    a FUSED_PANEL_LANES multiple (the Mosaic DMA alignment above — HBM
+    footprint 512 B/row at narrow shapes, the price of an aligned
+    single-burst gather).  Callers pass SENTINEL-padded inputs: the last
+    row must carry zero bins and zero weights, because the kernel
+    redirects every past-the-count position to it."""
+    import jax.numpy as jnp
+    from jax import lax
+    c = bins_pad.shape[1]
+    c_pad = -(-c // FUSED_COL_GROUP) * FUSED_COL_GROUP
+    if c_pad > c:
+        bins_pad = jnp.pad(bins_pad, ((0, 0), (0, c_pad - c)))
+    words, per = pack_gather_words(bins_pad)
+    panel = jnp.concatenate(
+        [words] + [lax.bitcast_convert_type(w.astype(jnp.float32),
+                                            jnp.uint32)[:, None]
+                   for w in (gw_pad, hw_pad, cw_pad)], axis=1)
+    wp = panel.shape[1]
+    wp_pad = -(-wp // FUSED_PANEL_LANES) * FUSED_PANEL_LANES
+    if wp_pad > wp:
+        panel = jnp.pad(panel, ((0, 0), (0, wp_pad - wp)))
+    return panel, per
 
 
 class PackPlan(NamedTuple):

@@ -40,8 +40,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .data.packing import PACK_JOINT_BINS, unfold_packed_hist
-from .ops.histogram import on_tpu, subset_histogram
+from .data.packing import (PACK_JOINT_BINS, pack_fused_panel,
+                           pack_gather_words, unfold_packed_hist,
+                           unpack_gather_words)
+from .ops.histogram import on_tpu, subset_histogram, subset_histogram_fused
+from .ops.pallas_hist import FUSED_MAX_COLS, NIB, fused_idx_fetch
 from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
                         best_split, leaf_output)
 from .utils import log
@@ -57,7 +60,10 @@ class GrowerConfig(NamedTuple):
     lambda_l2: float = 0.0
     min_gain_to_split: float = 0.0
     max_bin: int = 256               # B: histogram width (max over features)
-    hist_method: str = "auto"        # pallas | einsum | segment | auto
+    hist_method: str = "auto"        # fused | pallas | einsum | segment
+                                     # | auto (fused = gen-2 in-kernel
+                                     # gather; falls back to pallas when
+                                     # the layout cannot fuse)
     feat_tile: int = 8               # Pallas grid: features per block
     row_tile: int = 512              # Pallas grid: rows per block
     bucket_min_log2: int = 10        # smallest pow2 gather-buffer bucket
@@ -77,6 +83,9 @@ class GrowerConfig(NamedTuple):
     cat_smooth_ratio: float = 0.01
     min_cat_smooth: float = 5.0
     max_cat_smooth: float = 100.0
+    hist_interpret: bool = False     # run Pallas hist kernels in interpret
+                                     # mode — CPU-side parity tests of the
+                                     # fused/pallas paths (never on-chip)
 
     def split_config(self) -> SplitConfig:
         return SplitConfig(self.lambda_l1, self.lambda_l2, self.min_gain_to_split,
@@ -136,33 +145,34 @@ def decode_bundle_bin(raw, feat, meta: FeatureMeta):
     return jnp.where(off < 0, raw, sub)
 
 
-def pack_gather_words(mat: jnp.ndarray):
-    """[N, C] uint8/uint16 -> ([N, W] uint32, lanes_per_word).
-
-    On TPU a random row gather costs per ELEMENT, not per byte (measured
-    ~12.6 ns/elem on v5e through XLA's gather); packing 4 uint8 (or 2
-    uint16) bin columns into each uint32 word cuts the gathered element
-    count 4x (2x), and the unpack after the gather is a handful of
-    shift/mask vector ops that XLA fuses into the consumer."""
-    n, c = mat.shape
-    assert mat.dtype.itemsize <= 2, mat.dtype   # u32 words hold 4 u8 or 2 u16
-    per = 4 if mat.dtype.itemsize == 1 else 2
-    w = -(-c // per)
-    m = jnp.pad(mat, ((0, 0), (0, w * per - c))).astype(jnp.uint32)
-    m = m.reshape(n, w, per)
-    packed = m[:, :, 0]
-    for k in range(1, per):
-        packed = packed | (m[:, :, k] << (k * (32 // per)))
-    return packed, per
+# pack_gather_words / unpack_gather_words moved to data/packing.py (the
+# gen-2 fused kernel DMAs the same word layout in-kernel); imported above
+# so existing call sites — including scripts/tpu_microprobe.py — keep
+# working unchanged.
 
 
-def unpack_gather_words(words: jnp.ndarray, c: int, per: int) -> jnp.ndarray:
-    """[M, W] uint32 -> [M, C] int32 (inverse of :func:`pack_gather_words`)."""
-    shift = 32 // per
-    mask = jnp.uint32((1 << shift) - 1)
-    parts = [(words >> (k * shift)) & mask for k in range(per)]
-    stacked = jnp.stack(parts, axis=-1).reshape(words.shape[0], -1)
-    return stacked[:, :c].astype(jnp.int32)
+def fused_gate_reason(bins_dtype, weights_dtype, hist_width: int,
+                      n_hist_cols: int, use_ordered: bool):
+    """None when the gen-2 fused-gather kernel can run on this layout,
+    else the human-readable reason it cannot.
+
+    Shared by the grower's trace-time gate AND boosting's method
+    resolution: the resolved ``hist_method`` must always name the kernel
+    that actually runs, so a fused request on an unfusable layout is
+    downgraded BEFORE anything (bench labels, A/B artifacts) reads it."""
+    if jnp.dtype(bins_dtype).itemsize > 2:
+        return f"bin dtype {jnp.dtype(bins_dtype)} is wider than 2 bytes"
+    if jnp.dtype(weights_dtype) != jnp.float32:
+        return f"weights dtype {jnp.dtype(weights_dtype)} is not float32"
+    if hist_width > NIB * NIB:
+        return (f"histogram width {hist_width} exceeds the "
+                f"nibble-factorized limit {NIB * NIB}")
+    if n_hist_cols > FUSED_MAX_COLS:
+        return (f"{n_hist_cols} histogram columns exceed the kernel "
+                f"ceiling {FUSED_MAX_COLS}")
+    if use_ordered:
+        return "ordered_bins=on replaces the row gather entirely"
+    return None
 
 
 def _row_leaf_from_intervals(order, leaf_start, leaf_cnt, n):
@@ -466,6 +476,30 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             log.warning("gather_panel=on ignored: it needs gather_words on "
                         "and float32 weights (words=%s, dtype=%s)",
                         use_words, dtype)
+        # gen-2 fused-gather histogram rung: the kernel DMAs the indexed
+        # panel rows itself, so the gather-bucket lax.switch (and its pow2
+        # staging buffer) is RETIRED on this path — no ``branches`` are
+        # traced at all.  The layout prerequisites mirror the gather
+        # panel's; anything outside them degrades loudly to the
+        # hardware-proven gen-1 pallas rung (the A/B harness must never
+        # record mislabeled numbers).
+        n_hist_cols = hbins.shape[1]
+        use_fused = cfg.hist_method == "fused"
+        if use_fused:
+            reason = fused_gate_reason(hbins.dtype, dtype, hist_width,
+                                       n_hist_cols, use_ordered)
+            if reason is not None:
+                log.warning("hist_method=fused unavailable (%s); using the "
+                            "gen-1 pallas kernel", reason)
+                use_fused = False
+        base_method = "pallas" if cfg.hist_method == "fused" \
+            else cfg.hist_method
+        if use_fused:
+            # the fused panel subsumes the word/panel gather staging —
+            # nothing is gathered outside the kernel on this path
+            use_words, use_panel = "off", False
+            fused_panel, fused_per = pack_fused_panel(
+                hbins_pad, gw_pad, hw_pad, cw_pad)
         if use_words == "on":
             hwords_pad, words_per = pack_gather_words(hbins_pad)
             if use_panel:
@@ -480,10 +514,23 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
 
         def hist_subset(rows, g_, h_, c_):
             return subset_histogram(rows, g_, h_, c_, hist_width,
-                                    method=cfg.hist_method,
+                                    method=base_method,
                                     feat_tile=cfg.feat_tile,
                                     row_tile=cfg.row_tile,
-                                    impl=cfg.hist_impl)
+                                    impl=cfg.hist_impl,
+                                    interpret=cfg.hist_interpret)
+
+        def hist_fused_window(order, sstart, scnt):
+            """Fused rung: histogram the window [sstart, sstart + scnt) of
+            ``order`` with a DYNAMIC grid — ceil(scnt / row_tile) tiles, so
+            a small leaf costs a small kernel launch instead of a pow2
+            bucket (the lax.switch this path retires)."""
+            nt = jnp.maximum(1, (scnt + cfg.row_tile - 1) // cfg.row_tile)
+            return subset_histogram_fused(
+                order, fused_panel, sstart, scnt, n_hist_cols, fused_per,
+                hist_width, row_tile=cfg.row_tile,
+                num_row_tiles=nt.astype(jnp.int32),
+                interpret=cfg.hist_interpret)
 
         def measure(idx):
             """RAW histogram of rows ``idx`` (sentinel-padded): packed
@@ -530,7 +577,9 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 return measure(jnp.where(valid, idx, n))
             return branch
 
-        branches = [bucket_branch(s) for s in bsizes]
+        # fused rung: no gather buckets are traced at all — the pow2
+        # staging switch exists only for the fallback rungs
+        branches = None if use_fused else [bucket_branch(s) for s in bsizes]
 
         # ---- localized partition (DataPartition::Split,
         # data_partition.hpp:94-146).  The reference re-partitions only the
@@ -697,9 +746,16 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         root_h = strategy.reduce_scalar(jnp.sum(hw))
         root_c = strategy.reduce_scalar(jnp.sum(cw))
 
+        # fused rung: the kernel's aligned index over-fetch may read up to
+        # fused_idx_fetch(row_tile) past the window, so the sentinel tail
+        # must cover that beyond ``maxbuf`` (sentinel reads are harmless —
+        # they only ever resolve to the zero-weight panel row)
+        tail = maxbuf
+        if use_fused:
+            tail = max(maxbuf, fused_idx_fetch(cfg.row_tile))
         order0 = jnp.concatenate(
             [jnp.arange(n, dtype=jnp.int32),
-             jnp.full((maxbuf,), n, jnp.int32)])
+             jnp.full((tail,), n, jnp.int32)])
         if use_ordered:
             # rows start in natural order (order0 = iota), so the ordered
             # copies ARE the inputs; maxbuf tail rows never contribute
@@ -717,7 +773,19 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
 
         num_logical = meta.num_bin.shape[0]
         feat_ok_all = jnp.ones((num_logical,), bool)
-        hist_root = globalize(hist_subset(hbins, gw, hw, cw))
+        if use_fused:
+            # the fused rung is SELF-CONTAINED: the root histogram goes
+            # through the fused kernel too (static grid over the identity
+            # prefix of order0), because the gen-1 kernels' 3-D one-hot
+            # no longer Mosaic-lowers on current jax/libtpu (the fused
+            # kernel is the lowering-proven path; see test_mosaic_aot)
+            hist_root = globalize(subset_histogram_fused(
+                order0, fused_panel, 0, n, n_hist_cols, fused_per,
+                hist_width, row_tile=cfg.row_tile,
+                num_row_tiles=-(-n // cfg.row_tile),
+                interpret=cfg.hist_interpret))
+        else:
+            hist_root = globalize(hist_subset(hbins, gw, hw, cw))
         res_root, root_feat_ok = find(hist_root, root_g, root_h, root_c,
                                       feat_ok_all)
         res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
@@ -826,9 +894,14 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             small_left = splits.left_count[l] <= splits.right_count[l]
             sstart = jnp.where(small_left, start, start + nl)
             scnt = jnp.where(small_left, nl, nr)   # LOCAL count of that child
-            ki = _bucket_index(scnt, bsizes)
-            hist_small = lax.switch(ki, branches,
-                                    (order, obins, ow, sstart, scnt))
+            if use_fused:
+                # gen-2: the kernel gathers the window rows itself from the
+                # fused panel — no bucket switch, no staging buffer
+                hist_small = hist_fused_window(order, sstart, scnt)
+            else:
+                ki = _bucket_index(scnt, bsizes)
+                hist_small = lax.switch(ki, branches,
+                                        (order, obins, ow, sstart, scnt))
             hist_small = globalize(hist_small)
             hist_parent = lax.dynamic_index_in_dim(state.hist_store, l, axis=0,
                                                    keepdims=False)

@@ -9,14 +9,26 @@ split through its leaf-contiguous ``order`` array (the reference's
 smaller-child trick, ``serial_tree_learner.cpp:326-404``), so the work per
 split is proportional to the smaller child, not to the dataset:
 
-* ``pallas_hist.subset_histogram_pallas`` — bf16 MXU Pallas kernel whose
-  one-hot tile never leaves VMEM; hi/lo-split weights keep ~f32 accuracy
-  (the TPU path).
+* ``subset_histogram_fused`` (-> ``pallas_hist.hist6_fused``) — the gen-2
+  rung: the row gather happens INSIDE the Pallas kernel (per-tile DMA of
+  indexed panel rows into VMEM) and the contraction is nibble-factorized,
+  so neither the gathered [M, F] matrix nor the one-hot ever exists in
+  HBM.  Takes the leaf's ``order`` window + offset, not gathered rows.
+* ``pallas_hist.subset_histogram_pallas`` — gen-1 bf16 MXU Pallas kernel
+  over PRE-GATHERED rows; hi/lo-split weights keep ~f32 accuracy (the
+  hardware-proven TPU path, and the fallback when fused is unavailable).
 * ``subset_histogram_segment`` — one ``segment_sum`` scatter-add over the
   combined (feature, bin) index; the default CPU path (fallback rungs,
   test mesh), where scatter lowers well.
 * ``subset_histogram_einsum`` — chunked f32 one-hot einsum; the
   MXU-shaped debug/parity oracle (``use_pallas=false`` on TPU).
+
+The rung ladder, fastest projected first: fused > pallas > segment/einsum.
+``auto`` still resolves to the hardware-proven ``pallas`` on TPU — the
+fused rung is opt-in (``pallas_fused=on`` / the bench ladder's tpu+fused
+rung) until an on-chip A/B (bench_1m.json vs bench_1m_gen1.json in the
+capture playbook) proves its throughput win, exactly the discipline the
+nibble kernel's ``auto`` follows.
 
 Each histogram entry is ``(sum_gradients, sum_hessians, count)`` exactly like
 the reference ``HistogramBinEntry`` (``include/LightGBM/bin.h:27-56``).
@@ -121,23 +133,55 @@ def subset_histogram_segment(rows: jnp.ndarray, g: jnp.ndarray,
     return hist.reshape(f, num_bins, NUM_STATS)
 
 
+def subset_histogram_fused(order: jnp.ndarray, panel: jnp.ndarray,
+                           start, cnt, n_cols: int, words_per: int,
+                           num_bins: int, row_tile: int = 512,
+                           num_row_tiles=None,
+                           interpret: bool = False) -> jnp.ndarray:
+    """Gen-2 rung: histogram a leaf's ``order`` window WITHOUT a separate
+    gather pass — the kernel DMAs the indexed panel rows itself.
+
+    order [NO] i32 (window at [start, start + cnt); see hist6_fused for
+    the tail-padding contract), panel [N + 1, W + 3] u32
+    (data/packing.py:pack_fused_panel) -> [n_cols, num_bins, 3] f32 with
+    the same (sum_grad, sum_hess, count) layout and the same bf16 hi/lo
+    accuracy contract as the gen-1 pallas path (counts exact)."""
+    from .pallas_hist import hist6_fused
+    h6 = hist6_fused(order, panel, start, cnt, n_cols, words_per, num_bins,
+                     row_tile=row_tile, num_row_tiles=num_row_tiles,
+                     interpret=interpret)
+    return jnp.stack([h6[0] + h6[1], h6[2] + h6[3], h6[4]], axis=-1)
+
+
 def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                      c: jnp.ndarray, num_bins: int,
                      method: str = "auto", feat_tile: int = 8,
-                     row_tile: int = 512, impl: str = "auto") -> jnp.ndarray:
+                     row_tile: int = 512, impl: str = "auto",
+                     interpret: bool = False) -> jnp.ndarray:
     """Dispatch subset histogram: rows [M, F] int, g/h/c [M] -> [F, B, 3].
 
     ``feat_tile``/``row_tile`` shape the Pallas kernel's grid — the analogue
     of the reference GPU learner's workgroup tuning
     (gpu_tree_learner.cpp:103-121); ``impl`` picks the kernel formulation
-    (onehot | nibble | auto, see pallas_hist.hist6_pallas)."""
+    (onehot | nibble | auto, see pallas_hist.hist6_pallas); ``interpret``
+    runs the Pallas kernel in interpret mode (CPU-side parity tests).
+
+    ``method="fused"`` resolves to the gen-1 pallas kernel here: this
+    entry point receives PRE-GATHERED rows, and gathered rows have nothing
+    left to fuse — the fused rung enters through
+    :func:`subset_histogram_fused` (the grower calls it with the order
+    window + leaf offset instead of gathering; its root histogram uses
+    the fused kernel too, so only layout-gated fallbacks land here)."""
     if method == "auto":
+        # hardware-proven default; the fused rung stays opt-in until the
+        # on-chip A/B flips it (module docstring)
         method = "pallas" if on_tpu() else "segment"
-    if method == "pallas":
+    if method in ("pallas", "fused"):
         from .pallas_hist import subset_histogram_pallas
         return subset_histogram_pallas(rows, g, h, c, num_bins,
                                        feat_tile=feat_tile,
-                                       row_tile=row_tile, impl=impl)
+                                       row_tile=row_tile, impl=impl,
+                                       interpret=interpret)
     if method == "einsum":
         return subset_histogram_einsum(rows, g, h, c, num_bins)
     if method == "segment":

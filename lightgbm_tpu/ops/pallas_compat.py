@@ -1,0 +1,16 @@
+"""Version tolerance for the Pallas TPU API surface.
+
+The Pallas TPU namespace renamed ``TPUMemorySpace`` -> ``MemorySpace`` and
+``TPUCompilerParams`` -> ``CompilerParams`` across JAX releases; the
+container images this repo runs in have carried BOTH generations (the
+round-5 kernels were written against the new names and the whole
+``tests/test_compact.py`` module failed with ``AttributeError`` on a
+jax 0.4.x image).  Every kernel module imports the names from here so a
+runtime jax downgrade/upgrade can never take out the kernel tier again.
+"""
+from jax.experimental.pallas import tpu as pltpu
+
+MemorySpace = getattr(pltpu, "MemorySpace", None) \
+    or getattr(pltpu, "TPUMemorySpace")
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")

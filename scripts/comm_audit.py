@@ -117,6 +117,16 @@ def audit(learner, n_feat, max_bin, num_leaves=255, top_k=20):
         _uninstall()
     per_split = [r for r in RECORDS if r["per_split"]]
     per_tree = [r for r in RECORDS if not r["per_split"]]
+    # the per-split classifier matches a stack frame literally named
+    # 'body' inside grower.py; data/voting MUST issue per-split psums, so
+    # an empty set means the grower's while-loop body function was
+    # renamed and every collective silently reclassified as per-tree
+    # setup — fail loudly instead of generating a wrong PARALLEL_COST.md
+    if learner in ("data", "voting") and not per_split:
+        raise AssertionError(
+            f"{learner} learner traced 0 per-split collectives: the "
+            "'body' stack-frame classifier in _record() no longer "
+            "matches grower.py's while-loop body function")
     return {
         "learner": learner, "features": n_feat, "max_bin": max_bin,
         "num_leaves": num_leaves,

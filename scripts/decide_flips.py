@@ -16,6 +16,13 @@ import sys
 
 # (artifact, knob, action, baseline_artifact or None=headline)
 FLIPS = [
+    # INVERTED pair: the headline bench_1m.json is the tpu+fused number
+    # (the default ladder tries fused first), so this artifact is the
+    # forced gen-1 side — LOSE here means the fused kernel won and
+    # pallas_fused flips auto->on in config.py/boosting.py
+    ("bench_1m_gen1.json", "BENCH_FUSED=0 (gen-1 kernel forced)",
+     "if this LOSES >=5% to the headline, flip pallas_fused auto->on "
+     "(config.py) — the gen-2 fused kernel becomes the TPU default", None),
     ("bench_1m_ordered_sort.json", "ordered_bins=on + partition_impl=sort",
      "flip BOTH autos in boosting.py", None),
     ("bench_1m_compact.json", "partition_impl=compact",

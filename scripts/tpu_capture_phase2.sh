@@ -73,6 +73,17 @@ cat "$OUT/microprobe.json" | tee -a "$OUT/log.txt"
 snap "microprobe"
 
 alive_or_abort "microprobe"
+echo "== gen-1 forced A/B (fused rung dropped; headline pairs with this) ==" \
+    | tee -a "$OUT/log.txt"
+# the default ladder tries tpu+fused first, so bench_1m.json IS the gen-2
+# number when the kernel lowers; this stage forces the gen-1 rung for the
+# direct A/B pair (decide_flips: pallas_fused auto->on if fused wins >=5%)
+BENCH_TREES=6 BENCH_FUSED=0 BENCH_STAGE_TIMEOUT=1200 timeout 1500 \
+    python bench.py > "$OUT/bench_1m_gen1.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_1m_gen1.json" | tee -a "$OUT/log.txt"
+snap "gen-1 forced A/B"
+
+alive_or_abort "gen-1 A/B"
 echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on,partition_impl=sort \

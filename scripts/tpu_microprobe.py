@@ -19,6 +19,7 @@ Writes one JSON dict to stdout (plus progress on stderr); tpu_capture.sh
 saves it as evidence.  Runs on whatever backend jax picks - on CPU it is a
 rehearsal, numbers are only meaningful on the chip.
 """
+import functools
 import json
 import os
 import sys
@@ -146,6 +147,41 @@ def main():
     print(f"gather panel A/B: words+3cols "
           f"{res['gather_words_plus3_ms']:.1f} / panel "
           f"{res['gather_panel_ms']:.1f} ms", file=sys.stderr, flush=True)
+
+    # 4b3. gen-2 fused-gather kernel head-to-head with the gen-1 pipeline
+    # it replaces: compare hist_fused_ms[m] against gather_rows_words_ms
+    # (scaled by m/rows) + hist_ms[m] — the fused kernel folds both into
+    # one dispatch with no [M, F] staging buffer.  TPU only: interpret-
+    # mode timings mean nothing, and a Mosaic rejection here is itself
+    # evidence (recorded, like the compact probe).
+    if res["platform"] == "tpu":
+        try:
+            from lightgbm_tpu.data.packing import pack_fused_panel
+            from lightgbm_tpu.ops.histogram import subset_histogram_fused
+            from lightgbm_tpu.ops.pallas_hist import fused_idx_fetch
+            bins_pad = jnp.concatenate(
+                [bins_full, jnp.zeros((1, f), bins_full.dtype)])
+            wpad = jnp.concatenate([wg, jnp.zeros((1,), jnp.float32)])
+            fpanel, fper = pack_fused_panel(bins_pad, wpad, wpad, wpad)
+            order_f = jnp.concatenate(
+                [perm, jnp.full((fused_idx_fetch(512),), n, jnp.int32)])
+            jax.block_until_ready(fpanel)
+            res["hist_fused_ms"] = {}
+            for m in sizes:
+                nt = max(1, m // 512)
+                ffn = jax.jit(functools.partial(
+                    lambda o, cnt, nt: subset_histogram_fused(
+                        o, fpanel, 0, cnt, f, fper, 255,
+                        num_row_tiles=nt), cnt=m, nt=nt))
+                res["hist_fused_ms"][str(m)] = _t(
+                    lambda: ffn(order_f), n=5) * 1e3
+                print(f"hist fused {m} rows: "
+                      f"{res['hist_fused_ms'][str(m)]:.1f} ms",
+                      file=sys.stderr, flush=True)
+        except Exception as e:
+            res["hist_fused_error"] = str(e)[:300]
+            print(f"fused kernel probe failed: {e}",
+                  file=sys.stderr, flush=True)
 
     # 4c. does a row scatter cost per INDEX or per ELEMENT?  If per index,
     # the leaf-ordered-bins design (permuting [window, F] data rows with

@@ -113,6 +113,18 @@ def ref_bin():
 
 
 @pytest.fixture(scope="session")
+def reference_examples_available():
+    """Whether the reference repo's bundled example datasets are mounted.
+
+    The binary/regression fixtures silently fall back to synthetic data
+    when they are not — tests asserting ORACLE numbers measured on the
+    real datasets must check this and skip/re-scale instead of failing
+    against data the oracle never saw."""
+    return os.path.exists(
+        "/root/reference/examples/binary_classification/binary.train")
+
+
+@pytest.fixture(scope="session")
 def binary_example():
     """Reference bundled binary classification example (7000 x 28)."""
     path = "/root/reference/examples/binary_classification/binary.train"

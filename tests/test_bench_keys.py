@@ -1,0 +1,62 @@
+"""bench.py dataset-cache key invariant (ADVICE r5 #4).
+
+The bench memoizes constructed datasets on disk keyed by shape + the
+BINNING_KEYS subset of params.  A construction-relevant Config attribute
+read by the data layer but missing from that allowlist would silently
+reuse STALE cached datasets across A/B runs — the worst possible failure
+mode during a live tunnel window.  This test greps the data layer for
+every Config attribute it actually reads and asserts the allowlist stays
+a superset, so drift is caught in CI rather than in a window.
+"""
+import glob
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Config attributes the data layer reads that CANNOT change the
+# constructed dataset bytes.  Every exemption must carry its reason;
+# anything new and unexplained fails the test until it is classified
+# (either here or in BINNING_KEYS).
+NON_CONSTRUCTION_READS = {
+    "has_header",      # file parsing only — bench constructs from arrays,
+                       # and the parsed values, not the header flag, are
+                       # what binning consumes
+}
+
+
+def _data_layer_cfg_reads():
+    attrs = set()
+    pat = re.compile(r"\b(?:cfg|config)\.([a-z][a-z0-9_]*)\b")
+    for path in glob.glob(os.path.join(REPO, "lightgbm_tpu", "data", "*.py")):
+        with open(path) as f:
+            attrs |= set(pat.findall(f.read()))
+    return attrs
+
+
+def test_binning_keys_superset_of_data_layer_reads():
+    import bench
+    from lightgbm_tpu.config import Config
+    reads = _data_layer_cfg_reads()
+    # only attribute names that are actual Config fields matter (the regex
+    # also catches unrelated locals named cfg/config in principle)
+    fields = set(Config.__dataclass_fields__)
+    reads &= fields
+    assert reads, "grep found no Config reads — the pattern broke"
+    unexplained = reads - bench.BINNING_KEYS - NON_CONSTRUCTION_READS
+    assert not unexplained, (
+        f"lightgbm_tpu/data/ reads Config attributes {sorted(unexplained)} "
+        "that are neither in bench.BINNING_KEYS (construction-relevant -> "
+        "must key the dataset cache) nor exempted in "
+        "NON_CONSTRUCTION_READS (with a reason). Classify them.")
+
+
+def test_binning_keys_are_real_config_fields():
+    """The allowlist must not rot: every key must remain a Config field
+    (a renamed knob would otherwise silently stop keying the cache)."""
+    import bench
+    from lightgbm_tpu.config import Config
+    fields = set(Config.__dataclass_fields__)
+    missing = set(bench.BINNING_KEYS) - fields
+    assert not missing, f"BINNING_KEYS entries are not Config fields: " \
+                        f"{sorted(missing)}"

@@ -33,13 +33,17 @@ def test_binary():
         loss, abs=1e-5)
 
 
-def test_binary_reference_parity(binary_example):
+def test_binary_reference_parity(binary_example, reference_examples_available):
     """Quality parity vs the reference CLI on the bundled Higgs subset.
 
     Oracle numbers from the reference binary (v2.0.5, this machine):
     50 iters, num_leaves=15, min_data_in_leaf=50, lr=0.1 ->
     train binary_logloss 0.497858, valid 0.519989.
     """
+    if not reference_examples_available:
+        pytest.skip("reference example datasets not mounted: the oracle "
+                    "numbers were measured on the real binary.train, not "
+                    "the fixture's synthetic fallback")
     X, y, Xt, yt = binary_example
     params = {"objective": "binary", "metric": "binary_logloss",
               "verbose": -1, "num_leaves": 15, "min_data_in_leaf": 50}
@@ -56,7 +60,7 @@ def test_binary_reference_parity(binary_example):
         0.519989, abs=5e-3)
 
 
-def test_regression(regression_example):
+def test_regression(regression_example, reference_examples_available):
     X, y, Xt, yt = regression_example
     params = {"objective": "regression", "metric": "l2", "verbose": -1}
     train_data = lgb.Dataset(X, label=y)
@@ -67,7 +71,14 @@ def test_regression(regression_example):
                     verbose_eval=False)
     pred = bst.predict(Xt)
     mse = float(np.mean((pred - yt) ** 2))
-    assert mse < 1.0  # reference asserts < 16 on its harder synthetic set
+    if reference_examples_available:
+        # absolute threshold calibrated on the real regression.train
+        # (reference asserts < 16 on its harder synthetic set)
+        assert mse < 1.0
+    else:
+        # synthetic fallback (y = Xw + 0.3eps, var(y) ~ 28): the absolute
+        # bar is meaningless — assert the model explains most variance
+        assert mse < 0.35 * float(np.var(yt))
     assert evals_result["valid_0"]["l2"][-1] == pytest.approx(mse, abs=1e-4)
 
 

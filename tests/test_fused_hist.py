@@ -1,0 +1,224 @@
+"""Gen-2 fused-gather histogram kernel parity (fast tier).
+
+The kernel performs the row gather ITSELF (per-tile DMA of indexed panel
+rows) — so parity is pinned against the segment-sum oracle over the same
+window of a shared ``order`` array, across bin widths (incl. non-pow2),
+sentinel padding, dynamic grids, and the packed/EFB storage composition,
+all in interpret mode so regressions are caught without a TPU.  The
+Mosaic lowering proof lives in tests/test_mosaic_aot.py (slow tier); the
+on-chip throughput A/B is the capture playbook's bench_1m_gen1.json.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.data.packing import pack_fused_panel
+from lightgbm_tpu.ops.histogram import (subset_histogram_fused,
+                                        subset_histogram_segment)
+from lightgbm_tpu.ops.pallas_hist import fused_idx_fetch
+
+ROW_TILE = 512
+
+
+def _problem(n, f, b, seed=0, integer_weights=False, dtype=np.uint8):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, b, size=(n, f)).astype(dtype)
+    if integer_weights:
+        # bf16-exact weights: small integers survive the kernel's hi/lo
+        # split exactly and their f32 sums are order-independent, so the
+        # fused kernel must be BIT-identical to the segment oracle
+        g = rng.randint(-8, 9, size=n).astype(np.float32)
+        h = rng.randint(0, 5, size=n).astype(np.float32)
+    else:
+        g = rng.randn(n).astype(np.float32)
+        h = np.abs(rng.randn(n)).astype(np.float32)
+    c = (rng.rand(n) > 0.2).astype(np.float32)
+    return bins, g, h, c
+
+
+def _fused_inputs(bins, g, h, c):
+    """Sentinel-pad and panel-pack exactly the way the grower does."""
+    n, f = bins.shape
+    bins_pad = jnp.concatenate(
+        [jnp.asarray(bins), jnp.zeros((1, f), jnp.asarray(bins).dtype)])
+    pad1 = lambda x: jnp.concatenate([jnp.asarray(x), jnp.zeros((1,),
+                                                                jnp.float32)])
+    panel, per = pack_fused_panel(bins_pad, pad1(g), pad1(h), pad1(c))
+    return panel, per
+
+
+def _order_with_tail(perm, n):
+    return jnp.concatenate(
+        [jnp.asarray(perm, jnp.int32),
+         jnp.full((fused_idx_fetch(ROW_TILE),), n, jnp.int32)])
+
+
+@pytest.mark.parametrize("b", [255, 63, 256])   # non-pow2, small, full-joint
+def test_fused_matches_segment_oracle(b):
+    """Window histograms across bin widths, with a window that is NOT a
+    row-tile multiple (the final tile runs past cnt into sentinel rows)."""
+    n, f = 4096, 12
+    bins, g, h, c = _problem(n, f, b, seed=b)
+    panel, per = _fused_inputs(bins, g, h, c)
+    rng = np.random.RandomState(1)
+    perm = rng.permutation(n).astype(np.int32)
+    order = _order_with_tail(perm, n)
+    start, cnt = 700, 1900
+    sel = perm[start:start + cnt]
+    ref = np.asarray(subset_histogram_segment(
+        jnp.asarray(bins[sel]), jnp.asarray(g[sel]), jnp.asarray(h[sel]),
+        jnp.asarray(c[sel]), b))
+    nt = -(-cnt // ROW_TILE)
+    out = np.asarray(subset_histogram_fused(
+        order, panel, start, cnt, f, per, b, row_tile=ROW_TILE,
+        num_row_tiles=nt, interpret=True))
+    # bf16 hi/lo split: ~2^-17 relative error on g/h sums, counts exact
+    np.testing.assert_allclose(out, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(out[:, :, 2], ref[:, :, 2])
+
+
+def test_fused_bit_identical_integer_weights():
+    """With bf16-exact weights the fused kernel is BIT-identical to the
+    segment oracle — the round-5 pallas_compact discipline applied to a
+    kernel whose float path is otherwise tolerance-pinned."""
+    n, f, b = 3072, 28, 255
+    bins, g, h, c = _problem(n, f, b, seed=7, integer_weights=True)
+    panel, per = _fused_inputs(bins, g, h, c)
+    perm = np.random.RandomState(3).permutation(n).astype(np.int32)
+    order = _order_with_tail(perm, n)
+    start, cnt = 1029, 1536    # deliberately unaligned window start
+    sel = perm[start:start + cnt]
+    ref = np.asarray(subset_histogram_segment(
+        jnp.asarray(bins[sel]), jnp.asarray(g[sel]), jnp.asarray(h[sel]),
+        jnp.asarray(c[sel]), b))
+    out = np.asarray(subset_histogram_fused(
+        order, panel, start, cnt, f, per, b, row_tile=ROW_TILE,
+        num_row_tiles=-(-cnt // ROW_TILE), interpret=True))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_fused_dynamic_grid_matches_static():
+    """The grower's dynamic-grid form (traced tile count) must equal the
+    static grid bin for bin."""
+    import jax
+    n, f, b = 2048, 8, 63
+    bins, g, h, c = _problem(n, f, b, seed=11)
+    panel, per = _fused_inputs(bins, g, h, c)
+    perm = np.random.RandomState(5).permutation(n).astype(np.int32)
+    order = _order_with_tail(perm, n)
+    start, cnt = 333, 1000
+    static = np.asarray(subset_histogram_fused(
+        order, panel, start, cnt, f, per, b, row_tile=ROW_TILE,
+        num_row_tiles=2, interpret=True))
+
+    @jax.jit
+    def dyn(order, panel, start, cnt):
+        nt = jnp.maximum(1, (cnt + ROW_TILE - 1) // ROW_TILE)
+        return subset_histogram_fused(
+            order, panel, start, cnt, f, per, b, row_tile=ROW_TILE,
+            num_row_tiles=nt.astype(jnp.int32), interpret=True)
+    dynamic = np.asarray(dyn(order, panel, jnp.asarray(start, jnp.int32),
+                             jnp.asarray(cnt, jnp.int32)))
+    np.testing.assert_array_equal(static, dynamic)
+
+
+def test_fused_empty_and_tiny_windows():
+    """cnt = 0 (empty smaller child) must produce an all-zero histogram;
+    cnt = 1 a single-row one — both through the mandatory >= 1-tile grid."""
+    n, f, b = 1024, 4, 16
+    bins, g, h, c = _problem(n, f, b, seed=13)
+    panel, per = _fused_inputs(bins, g, h, c)
+    order = _order_with_tail(np.arange(n, dtype=np.int32), n)
+    empty = np.asarray(subset_histogram_fused(
+        order, panel, 5, 0, f, per, b, row_tile=ROW_TILE,
+        num_row_tiles=1, interpret=True))
+    assert (empty == 0).all()
+    one = np.asarray(subset_histogram_fused(
+        order, panel, 5, 1, f, per, b, row_tile=ROW_TILE,
+        num_row_tiles=1, interpret=True))
+    ref = np.asarray(subset_histogram_segment(
+        jnp.asarray(bins[5:6]), jnp.asarray(g[5:6]), jnp.asarray(h[5:6]),
+        jnp.asarray(c[5:6]), b))
+    np.testing.assert_array_equal(one[:, :, 2], ref[:, :, 2])
+    np.testing.assert_allclose(one, ref, rtol=3e-4, atol=3e-4)
+
+
+def _grow_tree_strings(hist_method, bins, g, h, c, num_bins, pack_plan=None,
+                       hist_bins=None, num_bin_arr=None):
+    import jax
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig, make_grower
+    f = bins.shape[1]
+    cfg = GrowerConfig(num_leaves=15, min_data_in_leaf=5, max_bin=num_bins,
+                       hist_method=hist_method,
+                       hist_interpret=hist_method == "fused")
+    meta = FeatureMeta(
+        num_bin=(jnp.asarray(num_bin_arr, jnp.int32)
+                 if num_bin_arr is not None
+                 else jnp.full((f,), num_bins, jnp.int32)),
+        missing_type=jnp.zeros((f,), jnp.int32),
+        default_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool))
+    grow = jax.jit(make_grower(cfg, pack_plan=pack_plan))
+    args = (jnp.asarray(bins),) + (
+        (jnp.asarray(hist_bins),) if pack_plan is not None else ())
+    tree, row_leaf = grow(*args, jnp.asarray(g), jnp.asarray(h),
+                          jnp.asarray(c), meta,
+                          jnp.ones((f,), bool))
+    return jax.tree_util.tree_map(np.asarray, tree), np.asarray(row_leaf)
+
+
+def test_grower_fused_tree_identical_to_segment():
+    """End-to-end: the full grower on the fused rung (interpret mode,
+    dynamic grids, no gather-bucket switch) grows the IDENTICAL tree to
+    the segment rung — structure, thresholds, and row routing."""
+    n, f, b = 3000, 10, 63
+    bins, g, h, c = _problem(n, f, b, seed=17)
+    c[:] = 1.0
+    t_seg, rl_seg = _grow_tree_strings("segment", bins, g, h, c, b)
+    t_fus, rl_fus = _grow_tree_strings("fused", bins, g, h, c, b)
+    assert int(t_seg.num_leaves) > 4          # the tree actually grew
+    np.testing.assert_array_equal(t_seg.split_feature, t_fus.split_feature)
+    np.testing.assert_array_equal(t_seg.threshold_bin, t_fus.threshold_bin)
+    np.testing.assert_array_equal(rl_seg, rl_fus)
+    np.testing.assert_allclose(t_seg.leaf_value, t_fus.leaf_value,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_grower_fused_packed_storage():
+    """The packed-pair (Dense4bits/EFB-style) composition: joint 256-bin
+    histograms over the packed storage matrix through the FUSED kernel,
+    unfolded to per-feature histograms — tree identical to segment."""
+    from lightgbm_tpu.data.packing import build_pack_plan, pack_columns
+    n, f = 2500, 12
+    col_bins = [255, 255] + [9] * (f - 2)      # 2 wide + 10 nibble-packable
+    rng = np.random.RandomState(23)
+    bins = np.stack([rng.randint(0, nb, size=n) for nb in col_bins],
+                    axis=1).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = np.abs(rng.randn(n)).astype(np.float32)
+    c = np.ones(n, np.float32)
+    plan = build_pack_plan(col_bins)
+    assert plan is not None and plan.num_packed == f - 2
+    packed = pack_columns(bins, plan)
+    kw = dict(pack_plan=plan, hist_bins=packed, num_bin_arr=col_bins)
+    t_seg, rl_seg = _grow_tree_strings("segment", bins, g, h, c, 255, **kw)
+    t_fus, rl_fus = _grow_tree_strings("fused", bins, g, h, c, 255, **kw)
+    assert int(t_seg.num_leaves) > 4
+    np.testing.assert_array_equal(t_seg.split_feature, t_fus.split_feature)
+    np.testing.assert_array_equal(t_seg.threshold_bin, t_fus.threshold_bin)
+    np.testing.assert_array_equal(rl_seg, rl_fus)
+
+
+def test_fused_warns_and_falls_back_on_wide_bins():
+    """A > 2-byte bin matrix cannot word-pack: the grower must degrade
+    loudly to the gen-1 kernel, not crash or mislabel."""
+    n, f, b = 1500, 6, 63
+    bins, g, h, c = _problem(n, f, b, seed=29, dtype=np.int32)
+    c[:] = 1.0
+    t_seg, _ = _grow_tree_strings("segment", bins, g, h, c, b)
+    # fused request on an unfusable layout: falls back to pallas;
+    # hist_interpret keeps the gen-1 kernel off Mosaic on this CPU host
+    t_fus, _ = _grow_tree_strings("fused", bins, g, h, c, b)
+    np.testing.assert_array_equal(t_seg.split_feature, t_fus.split_feature)
+    np.testing.assert_array_equal(t_seg.threshold_bin, t_fus.threshold_bin)

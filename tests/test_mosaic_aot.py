@@ -26,19 +26,29 @@ jax = pytest.importorskip("jax")
 
 @pytest.fixture(scope="module")
 def v5e():
-    from jax.experimental import topologies
+    # the persistent compile cache is a pure liability for this module:
+    # AOT topology executables written to it fail re-read with
+    # 'UNIMPLEMENTED: DeserializeLoadedExecutable' warnings on every rerun
+    # (cache churn, zero hit benefit — these compiles are uncacheable by
+    # design), so disable it for the fixture's lifetime and restore after
+    prev_cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
     try:
-        topo = topologies.get_topology_desc(platform="tpu",
-                                            topology_name="v5e:2x2")
-    except Exception as e:  # no libtpu in this environment
-        pytest.skip(f"TPU AOT topology unavailable: {e}")
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    mesh = Mesh(np.array(topo.devices[:1]), ("d",))
-    sh = NamedSharding(mesh, P())
+        from jax.experimental import topologies
+        try:
+            topo = topologies.get_topology_desc(platform="tpu",
+                                                topology_name="v5e:2x2")
+        except Exception as e:  # no libtpu in this environment
+            pytest.skip(f"TPU AOT topology unavailable: {e}")
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(topo.devices[:1]), ("d",))
+        sh = NamedSharding(mesh, P())
 
-    def arg(shape, dtype):
-        return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
-    return arg
+        def arg(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+        yield arg
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_cache_dir)
 
 
 @pytest.mark.parametrize("impl,num_bins,f", [
@@ -53,6 +63,58 @@ def test_hist_kernel_lowers(v5e, impl, num_bins, f):
         r, g, h, c, num_bins, impl=impl))
     fn.lower(v5e((m, f), jnp.int32), v5e((m,), jnp.float32),
              v5e((m,), jnp.float32), v5e((m,), jnp.float32)).compile()
+
+
+@pytest.mark.parametrize("dyn_grid,num_bins,f", [
+    (False, 255, 28), (True, 255, 28), (True, 63, 28), (True, 256, 12),
+])
+def test_fused_hist_kernel_lowers(v5e, dyn_grid, num_bins, f):
+    """The gen-2 fused-gather kernel Mosaic-compiles for v5e: in-kernel
+    index fetch (aligned over-read), per-row panel DMA, nibble
+    contraction — with both static and DYNAMIC (traced tile count) grids.
+    Offline runs of this proof caught FIVE real lowering failures that
+    every interpret-mode test passed: unaligned dynamic 1-D slice
+    offsets, non-tile-multiple slice lengths, sub-128-lane panel row
+    slices, an LLO compiler crash on integer-indexed (dim-squeezing)
+    DMAs, and narrow-bf16 shape-cast/broadcast rejections."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import subset_histogram_fused
+    from lightgbm_tpu.ops.pallas_hist import fused_idx_fetch
+    n, tr = 1 << 16, 512
+    pw = 128        # pack_fused_panel pads the row to a 128-lane multiple
+    no = n + fused_idx_fetch(tr)
+    if dyn_grid:
+        fn = jax.jit(lambda o, p, s, c, nt: subset_histogram_fused(
+            o, p, s, c, f, 4, num_bins, row_tile=tr, num_row_tiles=nt))
+        fn.lower(v5e((no,), jnp.int32), v5e((n + 1, pw), jnp.uint32),
+                 v5e((), jnp.int32), v5e((), jnp.int32),
+                 v5e((), jnp.int32)).compile()
+    else:
+        fn = jax.jit(lambda o, p, s, c: subset_histogram_fused(
+            o, p, s, c, f, 4, num_bins, row_tile=tr, num_row_tiles=16))
+        fn.lower(v5e((no,), jnp.int32), v5e((n + 1, pw), jnp.uint32),
+                 v5e((), jnp.int32), v5e((), jnp.int32)).compile()
+
+
+def test_fused_grower_lowers(v5e):
+    """The FULL grower on the fused rung (dynamic-grid kernel inside the
+    while-loop body, gather-bucket switch retired) Mosaic-compiles at the
+    bench config — always on, not gated behind LGBM_TPU_AOT_FULL: this is
+    the exact program the tpu+fused bench rung runs."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig, make_grower
+    n, f = 1 << 17, 28
+    cfg = GrowerConfig(num_leaves=255, min_data_in_leaf=1,
+                       min_sum_hessian_in_leaf=100.0, max_bin=255,
+                       hist_method="fused")
+    meta = FeatureMeta(
+        num_bin=v5e((f,), jnp.int32), missing_type=v5e((f,), jnp.int32),
+        default_bin=v5e((f,), jnp.int32),
+        is_categorical=v5e((f,), jnp.bool_))
+    grow = jax.jit(make_grower(cfg))
+    grow.lower(v5e((n, f), jnp.uint8), v5e((n,), jnp.float32),
+               v5e((n,), jnp.float32), v5e((n,), jnp.float32),
+               meta, v5e((f,), jnp.bool_)).compile()
 
 
 @pytest.mark.parametrize("npay", [0, 8, 10])
