@@ -41,7 +41,13 @@ failure was an unreachable TPU plugin; round-2 was a Mosaic compile error
 BENCH_FUSED=0 drops the fused rung — the capture playbook's forced-gen-1
 A/B (bench_1m_gen1.json) against the default ladder's headline.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"[, "degraded"]}.
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
+"telemetry"[, "degraded", "kernel_mismatch"]}.  The "telemetry" block
+carries the OBSERVED histogram-kernel identity (lightgbm_tpu.obs dispatch
+counters) — if it disagrees with the rung label the result is marked
+degraded + kernel_mismatch so decide_flips.py refuses to compare it.
+BENCH_TRACE=<path> additionally writes a Chrome-trace span file for the
+measured child (render: `python -m lightgbm_tpu.obs <path>`).
 """
 import json
 import os
@@ -208,9 +214,17 @@ def child_main():
     from lightgbm_tpu.data.dataset import construct
     from lightgbm_tpu.objectives import create_objective
     from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.obs import trace as obs_trace
+    from lightgbm_tpu.obs.counters import counters as obs_counters
     from lightgbm_tpu.utils import log as _log
 
     _log.set_verbosity(-1)
+    # telemetry: fresh counters per rung so the observed-kernel evidence is
+    # THIS child's; BENCH_TRACE collects a span trace alongside the JSON
+    obs_counters.reset()
+    bench_trace = os.environ.get("BENCH_TRACE", "")
+    if bench_trace:
+        obs_trace.start(bench_trace)
     platform = jax.devices()[0].platform
     params = {
         "objective": "binary",
@@ -261,6 +275,25 @@ def child_main():
     kernel_tag = (f", {resolved}" if platform == "tpu"
                   and resolved in ("fused", "pallas") else "")
 
+    # rung honesty: the telemetry dispatch counters record which kernel the
+    # grower ACTUALLY traced.  A disagreement with the resolved label (e.g.
+    # a fused request silently downgraded inside jit, or a pallas rung
+    # degraded to einsum) marks the rung degraded so decide_flips never
+    # compares mislabeled numbers.
+    trace_file = obs_trace.stop() if bench_trace else None
+    observed = obs_counters.observed_kernel()
+    telemetry = {
+        "observed_kernel": observed,
+        "hist_dispatch": obs_counters.get("hist_dispatch"),
+        "layout_downgrades": obs_counters.events("layout_downgrade"),
+    }
+    if trace_file:
+        telemetry["trace"] = trace_file
+    kernel_mismatch = observed is not None and observed != resolved
+    if kernel_mismatch:
+        sys.stderr.write(f"bench: KERNEL IDENTITY MISMATCH — rung label "
+                         f"{resolved}, telemetry observed {observed}\n")
+
     if "BENCH_BASELINE_TPS" in os.environ:
         # an externally measured baseline is tied to the shape it was
         # measured at (BENCH_BASELINE_ROWS, default: the requested
@@ -272,7 +305,7 @@ def child_main():
     else:
         baseline = (BASELINE_TREES_PER_SEC_1M
                     * (1_000_000 / n_rows) * (28 / n_feat))
-    print(json.dumps({
+    result = {
         "metric": f"higgs-like {n_rows // 1000}k x{n_feat} binary GBDT "
                   f"training throughput, {params['num_leaves']} leaves, "
                   f"{params['max_bin']} bins ({platform}{kernel_tag}"
@@ -281,7 +314,13 @@ def child_main():
         "unit": "trees/sec",
         "vs_baseline": round(trees_per_sec / baseline, 4),
         "link": link,
-    }))
+        "telemetry": telemetry,
+    }
+    if kernel_mismatch:
+        result["kernel_mismatch"] = True
+        result["degraded"] = (f"kernel identity mismatch: rung label "
+                              f"{resolved} but telemetry observed {observed}")
+    print(json.dumps(result))
 
 
 def _link_profile(jax):
@@ -438,9 +477,13 @@ def main():
         res = _run_child(platform, mode, timeout_s)
         if isinstance(res, dict):
             if errors:
+                # never clobber a child-reported degradation (e.g. the
+                # kernel-identity mismatch) — merge it in
+                prior = res.get("degraded")
                 res["degraded"] = ("fell back to "
                                    f"{_rung_label(platform, mode)}: "
-                                   + " ; ".join(errors))
+                                   + " ; ".join(errors)
+                                   + (f" ; {prior}" if prior else ""))
                 _attach_last_tpu_capture(res)
             print(json.dumps(res))
             return

@@ -29,6 +29,8 @@ from .config import Config
 from .data.dataset import TrainingData
 from .grower import FeatureMeta, GrowerConfig, make_grower
 from .metrics import Metric, create_metric, default_metric_for_objective
+from .obs import trace as obs_trace
+from .obs.counters import counters as obs_counters
 from .ops.histogram import on_tpu
 from .objectives import Objective, create_objective, parse_objective_string
 from .predictor import (Predictor, predict_binned_leaf, tree_scores_binned,
@@ -303,6 +305,10 @@ class GBDT:
                                 "matrix has its own layout); set "
                                 "enable_bin_packing=false to use the "
                                 "leaf-ordered path")
+                    obs_counters.event(
+                        "layout_downgrade", stage="boosting",
+                        requested="ordered_bins=on", resolved="off",
+                        reason="nibble bin packing is active")
                 self._hist_bins = pack_columns(np.asarray(train.binned),
                                                self._pack_plan)
                 log.info("Bin packing: %d of %d columns nibble-packed "
@@ -328,6 +334,9 @@ class GBDT:
             if reason is not None:
                 log.warning("pallas_fused=on unavailable (%s); using the "
                             "gen-1 pallas kernel", reason)
+                obs_counters.event("layout_downgrade", stage="boosting",
+                                   requested="fused", resolved="pallas",
+                                   reason=reason)
                 self.grower_cfg = self.grower_cfg._replace(
                     hist_method="pallas")
         # the bagged-subset optimization (gbdt.cpp:323-382 is_use_subset_)
@@ -578,7 +587,13 @@ class GBDT:
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True if training should stop
-        (gbdt.cpp:465-581 TrainOneIter)."""
+        (gbdt.cpp:465-581 TrainOneIter).  Each iteration is one telemetry
+        span; the per-phase spans inside come from ``self.timers``."""
+        with obs_trace.get_tracer().span("iteration", index=int(self.iter_)):
+            return self._train_one_iter_inner(grad, hess)
+
+    def _train_one_iter_inner(self, grad: Optional[np.ndarray] = None,
+                              hess: Optional[np.ndarray] = None) -> bool:
         if (self.iter_ == 0 and self.num_init_iteration == 0
                 and self.allow_boost_from_average
                 and self.objective is not None

@@ -206,6 +206,11 @@ class Config:
     output_result: str = "LightGBM_predict_result.txt"
     snapshot_freq: int = -1
     profile_dir: str = ""          # write a jax.profiler trace of training here
+    trace_path: str = ""           # write a Chrome-trace span file (.json or
+                                   # .jsonl) of training here (lightgbm_tpu.obs
+                                   # telemetry; implies telemetry=true; render
+                                   # with `python -m lightgbm_tpu.obs <path>`)
+    telemetry: bool = False        # enable the telemetry counters/spans (docs/OBSERVABILITY.md) without writing a trace file
     convert_model: str = "gbdt_prediction.cpp"
     convert_model_language: str = ""
 

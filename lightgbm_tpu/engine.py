@@ -38,6 +38,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
         num_boost_round = int(params.pop("num_iterations"))
     if "early_stopping_round" in params and params["early_stopping_round"]:
         early_stopping_rounds = int(params.pop("early_stopping_round"))
+    # structured telemetry (lightgbm_tpu.obs): trace_path writes a
+    # Chrome-trace span file; telemetry=true enables counters/spans without
+    # a file.  The counter registry is reset per training so two runs in
+    # one process never blur their kernel-identity evidence.
+    from .obs import trace as obs_trace
+    from .obs.counters import counters as obs_counters
+    trace_path = str(params.get("trace_path", "") or "")
+    telemetry_on = bool(trace_path) or str(
+        params.get("telemetry", "")).strip().lower() in ("true", "1", "yes",
+                                                         "on", "+")
+    if telemetry_on:
+        obs_counters.reset()
+        obs_trace.start(trace_path or None)
     if int(params.get("num_machines", 1)) > 1:
         # multi-host bring-up from config (application.cpp:190-224 analogue)
         from .config import config_from_params
@@ -111,42 +124,62 @@ def train(params: Dict[str, Any], train_set: Dataset,
         import jax
         profile_ctx = jax.profiler.trace(str(profile_dir))
 
-    with profile_ctx:
-        for i in range(num_boost_round):
-            for cb in cbs_before:
-                cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                            iteration=i, begin_iteration=0,
-                                            end_iteration=num_boost_round,
-                                            evaluation_result_list=None))
-            finished = booster.update(fobj=fobj)
-
-            if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
-                # gbdt.cpp:456-460: periodic model snapshots during training
-                booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
-
-            evaluation_result_list = []
-            if valid_sets:
-                if is_valid_contain_train:
-                    evaluation_result_list.extend(
-                        (train_data_name, m, v, hib)
-                        for (_, m, v, hib) in booster.eval_train(feval))
-                evaluation_result_list.extend(booster.eval_valid(feval))
-            try:
-                for cb in cbs_after:
+    train_span = obs_trace.get_tracer().span(
+        "train", num_boost_round=num_boost_round)
+    try:
+        with profile_ctx, train_span:
+            for i in range(num_boost_round):
+                for cb in cbs_before:
                     cb(callback_mod.CallbackEnv(
-                        model=booster, params=params, iteration=i,
-                        begin_iteration=0, end_iteration=num_boost_round,
-                        evaluation_result_list=evaluation_result_list))
-            except callback_mod.EarlyStopException as es:
-                booster.best_iteration = es.best_iteration + 1
-                for item in (es.best_score or []):
-                    booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-                break
-            if finished:
-                break
-    if booster.best_iteration <= 0:
-        booster.best_iteration = booster.current_iteration()
-    booster.inner.timers.report("training phase timers")
+                        model=booster, params=params,
+                        iteration=i, begin_iteration=0,
+                        end_iteration=num_boost_round,
+                        evaluation_result_list=None))
+                finished = booster.update(fobj=fobj)
+
+                if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+                    # gbdt.cpp:456-460: periodic model snapshots in training
+                    booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
+
+                evaluation_result_list = []
+                if valid_sets:
+                    if is_valid_contain_train:
+                        evaluation_result_list.extend(
+                            (train_data_name, m, v, hib)
+                            for (_, m, v, hib) in booster.eval_train(feval))
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+                try:
+                    for cb in cbs_after:
+                        cb(callback_mod.CallbackEnv(
+                            model=booster, params=params, iteration=i,
+                            begin_iteration=0, end_iteration=num_boost_round,
+                            evaluation_result_list=evaluation_result_list))
+                except callback_mod.EarlyStopException as es:
+                    booster.best_iteration = es.best_iteration + 1
+                    for item in (es.best_score or []):
+                        booster.best_score.setdefault(
+                            item[0], {})[item[1]] = item[2]
+                    break
+                if finished:
+                    break
+        if booster.best_iteration <= 0:
+            booster.best_iteration = booster.current_iteration()
+        booster.inner.timers.report("training phase timers")
+    finally:
+        if telemetry_on:
+            # recompile evidence: how many distinct (shape, donation)
+            # entries the grower jit accumulated this training — a number
+            # above the expected pow2-bucket count means buffer-identity
+            # churn forced recompiles
+            grow = getattr(booster.inner, "grow", None)
+            cache_size = getattr(grow, "_cache_size", None)
+            if callable(cache_size):
+                try:
+                    obs_counters.gauge("grower_jit_entries",
+                                       int(cache_size()))
+                except Exception:
+                    pass
+            obs_trace.stop()
     return booster
 
 

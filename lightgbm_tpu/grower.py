@@ -43,6 +43,8 @@ from jax import lax
 from .data.packing import (PACK_JOINT_BINS, pack_fused_panel,
                            pack_gather_words, unfold_packed_hist,
                            unpack_gather_words)
+from .obs import trace as obs_trace
+from .obs.counters import counters as obs_counters
 from .ops.histogram import on_tpu, subset_histogram, subset_histogram_fused
 from .ops.pallas_hist import FUSED_MAX_COLS, NIB, fused_idx_fetch
 from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
@@ -491,6 +493,9 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             if reason is not None:
                 log.warning("hist_method=fused unavailable (%s); using the "
                             "gen-1 pallas kernel", reason)
+                obs_counters.event("layout_downgrade", stage="grower",
+                                   requested="fused", resolved="pallas",
+                                   reason=reason)
                 use_fused = False
         base_method = "pallas" if cfg.hist_method == "fused" \
             else cfg.hist_method
@@ -509,16 +514,23 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                        for w in (gw_pad, hw_pad, cw_pad)], axis=1)
                 n_words = hwords_pad.shape[1]
 
-        def find(hist, pg, ph, pc, feat_ok):
-            return strategy.find(ctx, hist, pg, ph, pc, feat_ok)
+        # telemetry: host spans below fire at TRACE time (once per
+        # compilation); the jax.named_scope twins are baked into the HLO so
+        # XProf attributes the per-split kernels to the same names on-chip
+        tracer = obs_trace.get_tracer()
 
-        def hist_subset(rows, g_, h_, c_):
+        def find(hist, pg, ph, pc, feat_ok):
+            with tracer.span("split_find"), jax.named_scope("split_find"):
+                return strategy.find(ctx, hist, pg, ph, pc, feat_ok)
+
+        def hist_subset(rows, g_, h_, c_, site="split"):
             return subset_histogram(rows, g_, h_, c_, hist_width,
                                     method=base_method,
                                     feat_tile=cfg.feat_tile,
                                     row_tile=cfg.row_tile,
                                     impl=cfg.hist_impl,
-                                    interpret=cfg.hist_interpret)
+                                    interpret=cfg.hist_interpret,
+                                    site=site)
 
         def hist_fused_window(order, sstart, scnt):
             """Fused rung: histogram the window [sstart, sstart + scnt) of
@@ -530,7 +542,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 order, fused_panel, sstart, scnt, n_hist_cols, fused_per,
                 hist_width, row_tile=cfg.row_tile,
                 num_row_tiles=nt.astype(jnp.int32),
-                interpret=cfg.hist_interpret)
+                interpret=cfg.hist_interpret, site="split")
 
         def measure(idx):
             """RAW histogram of rows ``idx`` (sentinel-padded): packed
@@ -773,19 +785,22 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
 
         num_logical = meta.num_bin.shape[0]
         feat_ok_all = jnp.ones((num_logical,), bool)
-        if use_fused:
-            # the fused rung is SELF-CONTAINED: the root histogram goes
-            # through the fused kernel too (static grid over the identity
-            # prefix of order0), because the gen-1 kernels' 3-D one-hot
-            # no longer Mosaic-lowers on current jax/libtpu (the fused
-            # kernel is the lowering-proven path; see test_mosaic_aot)
-            hist_root = globalize(subset_histogram_fused(
-                order0, fused_panel, 0, n, n_hist_cols, fused_per,
-                hist_width, row_tile=cfg.row_tile,
-                num_row_tiles=-(-n // cfg.row_tile),
-                interpret=cfg.hist_interpret))
-        else:
-            hist_root = globalize(hist_subset(hbins, gw, hw, cw))
+        with tracer.span("histogram", site="root"), \
+                jax.named_scope("histogram"):
+            if use_fused:
+                # the fused rung is SELF-CONTAINED: the root histogram goes
+                # through the fused kernel too (static grid over the identity
+                # prefix of order0), because the gen-1 kernels' 3-D one-hot
+                # no longer Mosaic-lowers on current jax/libtpu (the fused
+                # kernel is the lowering-proven path; see test_mosaic_aot)
+                hist_root = globalize(subset_histogram_fused(
+                    order0, fused_panel, 0, n, n_hist_cols, fused_per,
+                    hist_width, row_tile=cfg.row_tile,
+                    num_row_tiles=-(-n // cfg.row_tile),
+                    interpret=cfg.hist_interpret, site="root"))
+            else:
+                hist_root = globalize(hist_subset(hbins, gw, hw, cw,
+                                                  site="root"))
         res_root, root_feat_ok = find(hist_root, root_g, root_h, root_c,
                                       feat_ok_all)
         res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
@@ -841,10 +856,11 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             start = state.leaf_start[l]
             cnt = state.leaf_cnt[l]
             kp = _bucket_index(cnt, bsizes)
-            order, obins, ow, nl = lax.switch(
-                kp, pbranches,
-                (state.order, state.obins, state.ow, start, cnt,
-                 feat, thr, dleft, splits.is_cat[l], splits.cat_bins[l]))
+            with tracer.span("partition"), jax.named_scope("partition"):
+                order, obins, ow, nl = lax.switch(
+                    kp, pbranches,
+                    (state.order, state.obins, state.ow, start, cnt,
+                     feat, thr, dleft, splits.is_cat[l], splits.cat_bins[l]))
             nr = cnt - nl
             leaf_start = _set(state.leaf_start, new_leaf, start + nl)
             leaf_cnt = _set(_set(state.leaf_cnt, l, nl), new_leaf, nr)
@@ -894,15 +910,17 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             small_left = splits.left_count[l] <= splits.right_count[l]
             sstart = jnp.where(small_left, start, start + nl)
             scnt = jnp.where(small_left, nl, nr)   # LOCAL count of that child
-            if use_fused:
-                # gen-2: the kernel gathers the window rows itself from the
-                # fused panel — no bucket switch, no staging buffer
-                hist_small = hist_fused_window(order, sstart, scnt)
-            else:
-                ki = _bucket_index(scnt, bsizes)
-                hist_small = lax.switch(ki, branches,
-                                        (order, obins, ow, sstart, scnt))
-            hist_small = globalize(hist_small)
+            with tracer.span("histogram", site="split"), \
+                    jax.named_scope("histogram"):
+                if use_fused:
+                    # gen-2: the kernel gathers the window rows itself from
+                    # the fused panel — no bucket switch, no staging buffer
+                    hist_small = hist_fused_window(order, sstart, scnt)
+                else:
+                    ki = _bucket_index(scnt, bsizes)
+                    hist_small = lax.switch(ki, branches,
+                                            (order, obins, ow, sstart, scnt))
+                hist_small = globalize(hist_small)
             hist_parent = lax.dynamic_index_in_dim(state.hist_store, l, axis=0,
                                                    keepdims=False)
             hist_large = hist_parent - hist_small

@@ -1,0 +1,19 @@
+"""lightgbm_tpu.obs — structured telemetry: spans, counters, collectives.
+
+Three pillars (see docs/OBSERVABILITY.md):
+
+* :mod:`.trace` — nested-span tracer; no-op when disabled, Chrome-trace
+  JSON/JSONL + ``jax.profiler.TraceAnnotation`` mirroring when enabled;
+* :mod:`.counters` — process-wide counters/events (histogram-kernel
+  dispatch identity, layout downgrades, collective bytes);
+* :mod:`.report` — ``python -m lightgbm_tpu.obs <trace>`` renders the
+  per-phase / per-kernel markdown tables.
+
+Enable from training via ``engine.train(params={"trace_path": ...})`` or
+``telemetry=true``; from the bench via ``BENCH_TRACE=<path>``.
+"""
+from . import trace
+from .counters import counters
+from .trace import get_tracer
+
+__all__ = ["trace", "counters", "get_tracer"]

@@ -1,0 +1,6 @@
+"""CLI: ``python -m lightgbm_tpu.obs <trace.json[l]>``."""
+import sys
+
+from .report import main
+
+sys.exit(main())

@@ -1,0 +1,106 @@
+"""Collective-traffic accounting helpers.
+
+Two complementary mechanisms, both trace-time (collective SHAPES are
+backend-independent — the mesh is the unit of sharding, not the wire — so
+byte counts measured while tracing hold for any same-shard-count slice):
+
+* :func:`intercept` — monkeypatch ``lax.psum``/``pmax``/``pmin``/
+  ``all_gather`` for a block and collect one record per traced collective
+  with the caller site and the per-split/per-tree classification.  This is
+  the machinery ``scripts/comm_audit.py`` originally grew privately
+  (``_record``/``_nbytes``); it now lives here so the audit script and any
+  ad-hoc analysis share one implementation.
+* :func:`note_collective` — explicit accounting call the distributed
+  strategies (``parallel/learner.py``) make next to each collective they
+  issue; feeds the ``collective_calls`` / ``collective_bytes`` counters of
+  :mod:`lightgbm_tpu.obs.counters` without any monkeypatching, so every
+  distributed training run carries its collective budget in telemetry.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import traceback
+from typing import Any, Dict, List, Optional
+
+INTERCEPTED_OPS = ("psum", "pmax", "pmin", "all_gather")
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total payload bytes of a pytree of arrays / tracers / shape structs."""
+    import jax
+    import numpy as np
+    total = 0
+    for x in jax.tree_util.tree_leaves(tree):
+        if hasattr(x, "dtype"):
+            size = getattr(x, "size", None)
+            if size is None:
+                size = int(np.prod(getattr(x, "shape", ())))
+            total += int(size) * x.dtype.itemsize
+    return total
+
+
+def classify_site(stack=None):
+    """(site, per_split) for the innermost lightgbm_tpu frame.
+
+    ``per_split`` matches a stack frame literally named ``body`` inside
+    grower.py — the grow loop is one ``lax.while_loop`` whose body is
+    traced exactly once, so collectives issued from it are the PER-SPLIT
+    set and everything else is per-tree setup (the same separation the
+    reference draws for its per-split ReduceScatter).  comm_audit fails
+    loudly if this classifier ever stops matching."""
+    if stack is None:
+        stack = traceback.extract_stack()
+    obs_dir = os.sep + "obs" + os.sep
+    site = next((f"{os.path.basename(f.filename)}:{f.lineno}"
+                 for f in reversed(stack)
+                 if "lightgbm_tpu" in f.filename
+                 and obs_dir not in f.filename), "?")
+    per_split = any(f.name == "body" and "grower.py" in f.filename
+                    for f in stack)
+    return site, per_split
+
+
+def note_collective(op: str, value: Any, axis: Any, site: str) -> None:
+    """Count one traced collective into the process counters (cheap: runs
+    once per compiled call site, never in the device hot loop)."""
+    from .counters import counters
+    nb = tree_nbytes(value)
+    counters.inc("collective_calls", op=op, site=site)
+    counters.inc("collective_bytes", value=nb, op=op, site=site)
+
+
+@contextlib.contextmanager
+def intercept(records: Optional[List[Dict[str, Any]]] = None,
+              count: bool = False):
+    """Intercept jax collectives for the duration of the block.
+
+    Yields the record list; each traced collective appends
+    ``{"op", "bytes", "axis", "site", "per_split"}`` (byte-compatible with
+    the fields ``scripts/comm_audit.py`` always emitted).  ``count=True``
+    additionally feeds the interception into the counter registry."""
+    from jax import lax
+    out: List[Dict[str, Any]] = [] if records is None else records
+    orig = {}
+
+    def wrap(name):
+        fn = getattr(lax, name)
+        orig[name] = fn
+
+        def inner(x, axis_name, **kw):
+            site, per_split = classify_site()
+            out.append({"op": name, "bytes": tree_nbytes(x),
+                        "axis": str(axis_name), "site": site,
+                        "per_split": per_split})
+            if count:
+                note_collective(name, x, axis_name, site)
+            return fn(x, axis_name, **kw)
+        return inner
+
+    for name in INTERCEPTED_OPS:
+        setattr(lax, name, wrap(name))
+    try:
+        yield out
+    finally:
+        for name, fn in orig.items():
+            setattr(lax, name, fn)

@@ -1,0 +1,112 @@
+"""Process-wide counter/event registry.
+
+The structured side of the telemetry subsystem: cheap named counters with
+optional tags, bounded structured events, and gauges.  The load-bearing
+users:
+
+* **histogram-kernel dispatch identity** — every dispatch site records
+  ``hist_dispatch`` tagged ``method=fused|pallas|einsum|segment`` (plus
+  ``pallas_impl`` tagged ``impl=onehot|nibble`` once the gen-1 kernel
+  resolves its form), so a ``BENCH_*.json`` can prove which kernel a rung
+  *actually* traced instead of trusting its label
+  (:func:`observed_kernel`, consumed by ``bench.py`` /
+  ``scripts/decide_flips.py``);
+* **layout-downgrade events** — the warn-once fallback paths (fused gate,
+  nibble width gate, gather_words/panel gating) also record a
+  ``layout_downgrade`` event with the machine-readable reason;
+* **collective accounting** — ``obs/collectives.py`` feeds
+  ``collective_calls`` / ``collective_bytes`` tagged by op + site.
+
+Counts recorded from inside jit tracing are TRACE-time counts (once per
+compiled call site), which is exactly the "per call site" identity the
+honesty checks need — a recompile shows up as a fresh increment.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, List, Optional
+
+
+def _tag_key(tags: Dict[str, Any]) -> str:
+    if not tags:
+        return ""
+    return ",".join(f"{k}={tags[k]}" for k in sorted(tags))
+
+
+class CounterRegistry:
+    """Thread-safe registry: counters[name][tag_key] -> number."""
+
+    MAX_EVENTS = 512     # bounded: telemetry must never grow without limit
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[str, float]] = {}
+        self._gauges: Dict[str, float] = {}
+        self._events: collections.deque = collections.deque(
+            maxlen=self.MAX_EVENTS)
+
+    # ------------------------------------------------------------- writers
+
+    def inc(self, name: str, value: float = 1, **tags) -> None:
+        key = _tag_key(tags)
+        with self._lock:
+            bucket = self._counters.setdefault(name, {})
+            bucket[key] = bucket.get(key, 0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def event(self, name: str, **fields) -> None:
+        """Record a structured event (layout downgrade, recompile, ...)."""
+        with self._lock:
+            self._events.append({"event": name, **fields})
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._events.clear()
+
+    # ------------------------------------------------------------- readers
+
+    def get(self, name: str) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters.get(name, {}))
+
+    def total(self, name: str) -> float:
+        with self._lock:
+            return sum(self._counters.get(name, {}).values())
+
+    def events(self, name: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+        return evs if name is None else [e for e in evs
+                                         if e.get("event") == name]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"counters": {n: dict(b)
+                                 for n, b in self._counters.items()},
+                    "gauges": dict(self._gauges),
+                    "events": list(self._events)}
+
+    # --------------------------------------------- derived: kernel identity
+
+    def observed_kernel(self) -> Optional[str]:
+        """The histogram-kernel identity this process actually traced: the
+        dominant ``method=`` tag of ``hist_dispatch`` (trace-time call-site
+        counts).  None when no histogram was dispatched yet."""
+        per_method: Dict[str, float] = {}
+        for key, v in self.get("hist_dispatch").items():
+            tags = dict(kv.split("=", 1) for kv in key.split(",") if "=" in kv)
+            m = tags.get("method")
+            if m:
+                per_method[m] = per_method.get(m, 0) + v
+        if not per_method:
+            return None
+        return max(per_method, key=per_method.get)
+
+
+counters = CounterRegistry()

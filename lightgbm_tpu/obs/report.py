@@ -1,0 +1,177 @@
+"""Render a telemetry trace into per-phase / per-kernel markdown tables.
+
+``python -m lightgbm_tpu.obs <trace>`` is the CLI wrapper.  Accepts every
+format ``obs/trace.py`` writes: a Chrome-trace object
+(``{"traceEvents": [...]}``), a bare JSON array, or JSONL (one event per
+line — a killed process leaves a readable prefix, so partial files parse
+too).  The trace is self-contained: the final ``telemetry.summary`` event
+carries the counter-registry snapshot (kernel dispatch identity, layout
+downgrades, collective bytes) alongside the span timeline.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_events(path: str) -> List[dict]:
+    with open(path) as f:
+        text = f.read()
+    text = text.strip()
+    if not text:
+        return []
+    if path.endswith(".jsonl") or "\n" in text and not text.startswith(("[", "{")):
+        events = []
+        for line in text.splitlines():
+            line = line.strip().rstrip(",")
+            if not line or line in ("[", "]"):
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue                  # tolerate a torn tail line
+        return events
+    obj = json.loads(text)
+    if isinstance(obj, dict):
+        return list(obj.get("traceEvents", []))
+    return list(obj)
+
+
+def summary_payload(events: List[dict], kind: str) -> Optional[dict]:
+    """Last embedded ``telemetry.summary`` payload of the given kind."""
+    out = None
+    for ev in events:
+        if ev.get("name") == "telemetry.summary":
+            args = ev.get("args", {})
+            if args.get("kind") == kind:
+                out = args.get("payload")
+    return out
+
+
+def phase_table(events: List[dict]) -> List[Dict[str, Any]]:
+    """Aggregate complete ("X") spans by name: count/total/mean/max (ms)."""
+    agg: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        agg.setdefault(ev["name"], []).append(float(ev.get("dur", 0)) / 1e3)
+    rows = []
+    for name, durs in agg.items():
+        rows.append({"span": name, "count": len(durs),
+                     "total_ms": sum(durs),
+                     "mean_ms": sum(durs) / len(durs),
+                     "max_ms": max(durs)})
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def _split_tags(key: str) -> Dict[str, str]:
+    return dict(kv.split("=", 1) for kv in key.split(",") if "=" in kv)
+
+
+def kernel_table(counters: Dict[str, Dict[str, float]]) -> List[Dict[str, Any]]:
+    rows = []
+    for name in ("hist_dispatch", "pallas_impl"):
+        for key, v in sorted(counters.get(name, {}).items()):
+            tags = _split_tags(key)
+            rows.append({"counter": name,
+                         "kernel": tags.get("method", tags.get("impl", "?")),
+                         "site": tags.get("site", "-"),
+                         "traced_calls": int(v)})
+    return rows
+
+
+def observed_kernel(counters: Dict[str, Dict[str, float]]) -> Optional[str]:
+    per: Dict[str, float] = {}
+    for key, v in counters.get("hist_dispatch", {}).items():
+        m = _split_tags(key).get("method")
+        if m:
+            per[m] = per.get(m, 0) + v
+    return max(per, key=per.get) if per else None
+
+
+def _md_table(headers: List[str], rows: List[List[Any]]) -> List[str]:
+    out = ["| " + " | ".join(headers) + " |",
+           "|" + "|".join("---" for _ in headers) + "|"]
+    for r in rows:
+        out.append("| " + " | ".join(str(c) for c in r) + " |")
+    return out
+
+
+def render(path: str) -> str:
+    events = load_events(path)
+    snap = summary_payload(events, "counters") or {}
+    counters = snap.get("counters", {})
+    lines = [f"# lightgbm_tpu telemetry report — `{path}`", ""]
+    obs = observed_kernel(counters)
+    if obs is not None:
+        lines += [f"**Observed histogram kernel identity:** `{obs}`", ""]
+    lines += ["## Per-phase spans", "",
+              "Host wall-clock spans (Chrome-trace `X` events; spans "
+              "emitted from inside jit fire at trace time, once per "
+              "compilation).", ""]
+    prows = phase_table(events)
+    if prows:
+        lines += _md_table(
+            ["span", "count", "total ms", "mean ms", "max ms"],
+            [[r["span"], r["count"], f"{r['total_ms']:.3f}",
+              f"{r['mean_ms']:.3f}", f"{r['max_ms']:.3f}"] for r in prows])
+    else:
+        lines.append("(no spans recorded)")
+    lines += ["", "## Per-kernel dispatch identity", ""]
+    krows = kernel_table(counters)
+    if krows:
+        lines += _md_table(
+            ["counter", "kernel", "site", "traced calls"],
+            [[r["counter"], r["kernel"], r["site"], r["traced_calls"]]
+             for r in krows])
+    else:
+        lines.append("(no kernel dispatches recorded)")
+    coll = counters.get("collective_bytes", {})
+    if coll:
+        lines += ["", "## Collectives (trace-time payloads)", ""]
+        lines += _md_table(
+            ["op", "site", "bytes"],
+            [[_split_tags(k).get("op", "?"), _split_tags(k).get("site", "-"),
+              int(v)] for k, v in sorted(coll.items())])
+    events_list = snap.get("events", [])
+    if events_list:
+        lines += ["", "## Structured events", ""]
+        for e in events_list[-32:]:
+            kind = e.get("event", "?")
+            rest = {k: v for k, v in e.items() if k != "event"}
+            lines.append(f"- `{kind}` {json.dumps(rest)}")
+    gauges = snap.get("gauges", {})
+    if gauges:
+        lines += ["", "## Gauges", ""]
+        for k, v in sorted(gauges.items()):
+            lines.append(f"- `{k}` = {v}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if not argv:
+        sys.stderr.write(
+            "usage: python -m lightgbm_tpu.obs [--json] <trace.json[l]>\n")
+        return 2
+    path = argv[0]
+    try:
+        if as_json:
+            events = load_events(path)
+            print(json.dumps({
+                "phases": phase_table(events),
+                "summary": summary_payload(events, "counters") or {}},
+                indent=1))
+        else:
+            print(render(path))
+    except BrokenPipeError:      # `... | head` closing the pipe is fine
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+    return 0

@@ -1,0 +1,216 @@
+"""Nested-span tracer: Chrome-trace JSON/JSONL out, XProf-correlated.
+
+The reference only ever had ``#ifdef TIMETAG`` chrono counters
+(``serial_tree_learner.cpp:10-37``); here the evidence is produced by the
+library itself.  One process-wide active tracer (module functions
+:func:`start` / :func:`stop` / :func:`get_tracer`):
+
+* **disabled** (the default) it is a :class:`NullTracer` whose ``span()``
+  returns ONE shared no-op context manager — the hot-loop cost of an
+  instrumented phase is a dict lookup and two no-op calls, no allocation
+  (pinned by ``tests/test_obs.py::test_disabled_tracer_is_allocation_free``);
+* **enabled** it records wall-clock spans as Chrome trace events
+  (``ph: "X"``, microsecond ``ts``/``dur``) and mirrors every span into
+  ``jax.profiler.TraceAnnotation`` so host spans line up with XProf
+  captures taken via ``profile_dir`` on-chip.
+
+Output format follows the Chrome Trace Event spec: a ``*.jsonl`` path gets
+one event object per line (append-friendly, crash-tolerant — a killed
+child still leaves a readable prefix); any other path gets the standard
+``{"traceEvents": [...], "otherData": {...}}`` object.  Counter/summary
+payloads (the :mod:`lightgbm_tpu.obs.counters` snapshot, phase-timer
+totals) are embedded as instant events named ``telemetry.summary`` so one
+file carries the whole story; ``obs/report.py`` renders it.
+
+Spans emitted from inside jitted code (the grower) fire at TRACE time —
+once per compilation, not per execution; their on-device counterpart is
+the ``jax.named_scope`` annotation baked into the lowered HLO, which XProf
+attributes per kernel launch.  ``obs/report.py`` labels them accordingly.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# resolved lazily; False once probing failed (jax absent / too old)
+_TraceAnnotation: Any = None
+
+
+def _jax_annotation(name: str):
+    global _TraceAnnotation
+    if _TraceAnnotation is None:
+        try:
+            from jax.profiler import TraceAnnotation as ta
+            _TraceAnnotation = ta
+        except Exception:  # pragma: no cover - jax is a hard dep here
+            _TraceAnnotation = False
+    return _TraceAnnotation(name) if _TraceAnnotation else None
+
+
+class _NullSpan:
+    """Shared no-op context manager (the disabled fast path)."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """Disabled tracer: every operation is a no-op; ``span()`` hands back
+    the one shared :data:`NULL_SPAN` so the instrumented hot loops never
+    allocate when telemetry is off."""
+    enabled = False
+    path: Optional[str] = None
+
+    def span(self, name: str, **args):
+        return NULL_SPAN
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+    def summary(self, name: str, payload: Dict[str, Any]) -> None:
+        pass
+
+    def events(self) -> List[dict]:
+        return []
+
+
+NULL_TRACER = NullTracer()
+
+
+class _Span:
+    __slots__ = ("_tr", "_name", "_args", "_ts", "_jax")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self._tr = tracer
+        self._name = name
+        self._args = args
+        self._ts = 0.0
+        self._jax = None
+
+    def __enter__(self):
+        ann = _jax_annotation(self._name)
+        if ann is not None:
+            ann.__enter__()
+            self._jax = ann
+        self._ts = self._tr._now_us()
+        return self
+
+    def __exit__(self, *exc):
+        dur = self._tr._now_us() - self._ts
+        if self._jax is not None:
+            self._jax.__exit__(*exc)
+        ev = {"name": self._name, "ph": "X", "ts": round(self._ts, 3),
+              "dur": round(dur, 3), "pid": self._tr.pid,
+              "tid": threading.get_ident()}
+        if self._args:
+            ev["args"] = self._args
+        self._tr._append(ev)
+        return False
+
+
+class Tracer:
+    """Recording tracer.  Thread-safe; timestamps are microseconds since
+    construction (``perf_counter`` based, like the phase timers)."""
+    enabled = True
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.pid = os.getpid()
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            self._events.append(ev)
+
+    def span(self, name: str, **args) -> _Span:
+        """Context manager recording one complete ("X") event; nesting is
+        expressed through ts/dur containment, exactly how Chrome/Perfetto
+        rebuild the flame graph."""
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args) -> None:
+        ev = {"name": name, "ph": "i", "s": "p", "ts": round(self._now_us(), 3),
+              "pid": self.pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def summary(self, name: str, payload: Dict[str, Any]) -> None:
+        """Attach a structured summary payload (phase-timer totals, counter
+        snapshots) as a ``telemetry.summary`` instant event."""
+        self.instant("telemetry.summary", kind=name, **{"payload": payload})
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def write(self, path: Optional[str] = None) -> Optional[str]:
+        """Serialize to ``path`` (default: the constructor path).  Embeds a
+        final summary event carrying the current counter-registry snapshot
+        so the trace file is self-contained."""
+        path = path or self.path
+        from .counters import counters  # lazy: avoid import cycles
+        self.summary("counters", counters.snapshot())
+        if not path:
+            return None
+        events = self.events()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            if path.endswith(".jsonl"):
+                for ev in events:
+                    f.write(json.dumps(ev) + "\n")
+            else:
+                json.dump({"traceEvents": events,
+                           "otherData": {"producer": "lightgbm_tpu.obs"}}, f)
+        return path
+
+
+_active: Any = NULL_TRACER
+
+
+def get_tracer():
+    """The process-wide active tracer (NullTracer when telemetry is off)."""
+    return _active
+
+
+def start(path: Optional[str] = None) -> Tracer:
+    """Install a recording tracer as the process-wide active one."""
+    global _active
+    _active = Tracer(path)
+    return _active
+
+
+def stop() -> Optional[str]:
+    """Write the active trace (if it has a path) and disable tracing.
+    Returns the written path, or None."""
+    global _active
+    tr, _active = _active, NULL_TRACER
+    if isinstance(tr, Tracer):
+        return tr.write()
+    return None
+
+
+@contextlib.contextmanager
+def tracing(path: Optional[str] = None):
+    """``with tracing("t.json"):`` — enable for a block, write on exit."""
+    tr = start(path)
+    try:
+        yield tr
+    finally:
+        stop()

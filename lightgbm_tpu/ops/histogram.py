@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.counters import counters as obs_counters
+
 NUM_STATS = 3     # (sum_grad, sum_hess, count)
 
 
@@ -137,7 +139,8 @@ def subset_histogram_fused(order: jnp.ndarray, panel: jnp.ndarray,
                            start, cnt, n_cols: int, words_per: int,
                            num_bins: int, row_tile: int = 512,
                            num_row_tiles=None,
-                           interpret: bool = False) -> jnp.ndarray:
+                           interpret: bool = False,
+                           site: str = "split") -> jnp.ndarray:
     """Gen-2 rung: histogram a leaf's ``order`` window WITHOUT a separate
     gather pass — the kernel DMAs the indexed panel rows itself.
 
@@ -147,6 +150,10 @@ def subset_histogram_fused(order: jnp.ndarray, panel: jnp.ndarray,
     the same (sum_grad, sum_hess, count) layout and the same bf16 hi/lo
     accuracy contract as the gen-1 pallas path (counts exact)."""
     from .pallas_hist import hist6_fused
+    # dispatch-identity evidence (trace-time, per call site): bench rungs
+    # and decide_flips verify the label against this counter
+    obs_counters.inc("hist_dispatch", method="fused", site=site,
+                     interpret=bool(interpret))
     h6 = hist6_fused(order, panel, start, cnt, n_cols, words_per, num_bins,
                      row_tile=row_tile, num_row_tiles=num_row_tiles,
                      interpret=interpret)
@@ -157,7 +164,8 @@ def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                      c: jnp.ndarray, num_bins: int,
                      method: str = "auto", feat_tile: int = 8,
                      row_tile: int = 512, impl: str = "auto",
-                     interpret: bool = False) -> jnp.ndarray:
+                     interpret: bool = False,
+                     site: str = "split") -> jnp.ndarray:
     """Dispatch subset histogram: rows [M, F] int, g/h/c [M] -> [F, B, 3].
 
     ``feat_tile``/``row_tile`` shape the Pallas kernel's grid — the analogue
@@ -176,6 +184,13 @@ def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         # hardware-proven default; the fused rung stays opt-in until the
         # on-chip A/B flips it (module docstring)
         method = "pallas" if on_tpu() else "segment"
+    # the RESOLVED method, per call site — trace-time counts that the
+    # rung-honesty checks (bench.py / decide_flips.py) read back; a
+    # pre-gathered "fused" request lands on the gen-1 pallas kernel, so
+    # it is recorded as pallas (the identity that actually runs)
+    obs_counters.inc("hist_dispatch",
+                     method=("pallas" if method == "fused" else method),
+                     site=site, interpret=bool(interpret))
     if method in ("pallas", "fused"):
         from .pallas_hist import subset_histogram_pallas
         return subset_histogram_pallas(rows, g, h, c, num_bins,

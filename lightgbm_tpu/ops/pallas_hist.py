@@ -36,6 +36,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs.counters import counters as obs_counters
 from ..utils import log
 from .pallas_compat import CompilerParams, MemorySpace
 
@@ -162,7 +163,14 @@ def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
             log.warning("pallas_hist_impl=nibble needs a 256-wide histogram "
                         "axis (got %d bins); using the one-hot kernel",
                         num_bins)
+            obs_counters.event("layout_downgrade", stage="pallas_hist",
+                               requested="nibble", resolved="onehot",
+                               reason=f"histogram axis pads to {b_pad}, "
+                                      "nibble needs 256")
         impl = "onehot"
+    # resolved kernel FORM (onehot vs nibble) — the fine-grained identity
+    # under hist_dispatch's method=pallas (trace-time, per call site)
+    obs_counters.inc("pallas_impl", impl=impl)
     if impl == "nibble":
         assert b_pad == 2 * LANES and (feat_tile * NIB) % LANES == 0, \
             (num_bins, feat_tile)

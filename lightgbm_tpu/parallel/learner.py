@@ -42,6 +42,7 @@ _CHECK_KW = ("check_vma" if "check_vma"
 
 from ..grower import (FeatureMeta, GrowerConfig, SerialStrategy, TreeArrays,
                       expand_bundle_hist, make_expand_maps, make_grower)
+from ..obs.collectives import note_collective
 from ..ops.split import SplitResult, best_split, per_feature_best_gain
 
 
@@ -49,6 +50,9 @@ def _broadcast_from_winner(res: SplitResult, axis_name: str) -> SplitResult:
     """Gain-argmax sync across an axis (SyncUpGlobalBestSplit analogue):
     lowest-ranked shard with the maximal gain wins; its SplitResult is
     broadcast with a psum of masked fields."""
+    # one accounting entry for the whole sync (its psums cover every
+    # SplitResult field; pmax/pmin ride along at scalar cost)
+    note_collective("psum", res, axis_name, site="best_split_sync")
     n_shards = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     gmax = lax.pmax(jnp.where(res.found, res.gain, -jnp.inf), axis_name)
@@ -87,9 +91,11 @@ class DataParallelStrategy(SerialStrategy):
         self.axis = axis_name
 
     def reduce_hist(self, hist):
+        note_collective("psum", hist, self.axis, site="reduce_hist")
         return lax.psum(hist, self.axis)
 
     def reduce_scalar(self, x):
+        note_collective("psum", x, self.axis, site="reduce_scalar")
         return lax.psum(x, self.axis)
 
 
@@ -164,7 +170,9 @@ class FeatureParallelStrategy(SerialStrategy):
                 jnp.zeros_like(feat_ok), ok, (start,))
         # every shard owns a disjoint feature window: OR across shards
         # rebuilds the full is_splittable vector identically everywhere
-        ok_global = lax.psum(ok_global.astype(jnp.int32), self.axis) > 0
+        ok_i32 = ok_global.astype(jnp.int32)
+        note_collective("psum", ok_i32, self.axis, site="feat_ok_sync")
+        ok_global = lax.psum(ok_i32, self.axis) > 0
         return _broadcast_from_winner(res, self.axis), ok_global
 
 
@@ -187,9 +195,11 @@ class DataFeatureStrategy(FeatureParallelStrategy):
         self.data_axis = data_axis
 
     def reduce_hist(self, hist):
+        note_collective("psum", hist, self.data_axis, site="reduce_hist")
         return lax.psum(hist, self.data_axis)
 
     def reduce_scalar(self, x):
+        note_collective("psum", x, self.data_axis, site="reduce_scalar")
         return lax.psum(x, self.data_axis)
 
 
@@ -218,6 +228,7 @@ class VotingStrategy(SerialStrategy):
             min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf / num_shards)
 
     def reduce_scalar(self, x):
+        note_collective("psum", x, self.axis, site="reduce_scalar")
         return lax.psum(x, self.axis)
 
     # reduce_hist stays identity: histograms remain LOCAL and only the
@@ -253,17 +264,19 @@ class VotingStrategy(SerialStrategy):
             meta.missing_type, meta.default_bin, feat_valid, self.local_scfg,
             is_cat=meta.is_categorical)
         _, local_top = lax.top_k(local_gain, k)
-        gathered = lax.all_gather(
-            jnp.stack([local_gain[local_top],
-                       local_top.astype(local_gain.dtype)], axis=-1),
-            self.axis)                                   # [S, k, 2]
+        votes_local = jnp.stack([local_gain[local_top],
+                                 local_top.astype(local_gain.dtype)], axis=-1)
+        note_collective("all_gather", votes_local, self.axis, site="votes")
+        gathered = lax.all_gather(votes_local, self.axis)    # [S, k, 2]
         votes = gathered.reshape(-1, 2)
         # global top-2k by voted gain (GlobalVoting :165-195); duplicate
         # feature ids are harmless (redundant reduced slices)
         _, top_idx = lax.top_k(votes[:, 0], min(2 * k, votes.shape[0]))
         sel = votes[top_idx, 1].astype(jnp.int32)        # [2k]
         # reduce only the selected features' histograms (CopyLocalHistogram)
-        hist_sel = lax.psum(hist_child[sel], self.axis)  # [2k, B, 3]
+        hist_voted = hist_child[sel]
+        note_collective("psum", hist_voted, self.axis, site="voted_hist")
+        hist_sel = lax.psum(hist_voted, self.axis)       # [2k, B, 3]
         res, sel_ok = best_split(hist_sel, pg, ph, pc, meta.num_bin[sel],
                                  meta.missing_type[sel],
                                  meta.default_bin[sel],

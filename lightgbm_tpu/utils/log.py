@@ -11,9 +11,18 @@ import logging
 import sys
 
 _logger = logging.getLogger("lightgbm_tpu")
-if not _logger.handlers:
+# attach exactly ONE handler that WE own.  The guard must be on the
+# handler's identity, not `if not _logger.handlers`: under pytest the
+# logging plugin (or a user's config) may have attached its own handler
+# to this logger first, and a bare emptiness check would then either skip
+# our handler entirely or — after an importlib.reload() — attach a second
+# copy and double-print every line.  The ownership flag makes repeated
+# imports/reloads idempotent regardless of what else is attached.
+_OWNED_FLAG = "_lightgbm_tpu_owned"
+if not any(getattr(h, _OWNED_FLAG, False) for h in _logger.handlers):
     _h = logging.StreamHandler(sys.stderr)
     _h.setFormatter(logging.Formatter("[LightGBM-TPU] [%(levelname)s] %(message)s"))
+    setattr(_h, _OWNED_FLAG, True)
     _logger.addHandler(_h)
     _logger.setLevel(logging.INFO)
 

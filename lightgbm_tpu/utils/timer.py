@@ -3,9 +3,12 @@
 The reference accumulates per-phase ``std::chrono`` counters under
 ``#ifdef TIMETAG`` (``serial_tree_learner.cpp:10-37``, ``gbdt.cpp:22-64``)
 and dumps them at destruction.  Here the counters are always on (the cost is
-one clock read per phase) and reported through the logger; deep kernel-level
-profiles come from ``jax.profiler`` instead (see ``engine.train``'s
-``profile_dir`` parameter).
+one clock read per phase) and reported through the logger; each phase is
+additionally mirrored into the telemetry tracer (``lightgbm_tpu.obs``) —
+a shared no-op when telemetry is disabled, a Chrome-trace span (plus
+``jax.profiler.TraceAnnotation`` for XProf correlation) when enabled.
+Deep kernel-level profiles come from ``jax.profiler`` instead (see
+``engine.train``'s ``profile_dir`` parameter).
 """
 from __future__ import annotations
 
@@ -14,6 +17,7 @@ import contextlib
 import time
 from typing import Dict
 
+from ..obs import trace as obs_trace
 from . import log
 
 
@@ -27,9 +31,12 @@ class PhaseTimers:
     @contextlib.contextmanager
     def phase(self, name: str):
         t0 = time.perf_counter()
+        span = obs_trace.get_tracer().span(name)
+        span.__enter__()
         try:
             yield
         finally:
+            span.__exit__(None, None, None)
             self.seconds[name] += time.perf_counter() - t0
             self.counts[name] += 1
 
@@ -42,6 +49,10 @@ class PhaseTimers:
                  for k, v in sorted(self.seconds.items(), key=lambda kv: -kv[1])]
         text = f"{header}: " + ", ".join(parts) if parts else f"{header}: (empty)"
         log.debug("%s", text)
+        # telemetry sink as well as the logger: the totals land in the
+        # trace file's summary stream (no-op when telemetry is off)
+        obs_trace.get_tracer().summary(header, {
+            "seconds": dict(self.seconds), "counts": dict(self.counts)})
         return text
 
     def reset(self) -> None:

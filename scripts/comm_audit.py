@@ -9,6 +9,10 @@ PER-SPLIT set and everything else is the per-tree setup set — the same
 separation the reference draws between its per-split ReduceScatter
 (data_parallel_tree_learner.cpp:148-163) and its per-tree global stats.
 
+The interception itself is ``lightgbm_tpu.obs.collectives.intercept`` (the
+telemetry subsystem's shared helper — record fields are unchanged from the
+private ``_record``/``_nbytes`` this script used to carry).
+
 Writes a JSON table to stdout; docs/PARALLEL_COST.md is generated from it
 (scripts/comm_audit.py --markdown > docs/PARALLEL_COST.md).
 
@@ -21,7 +25,6 @@ import argparse
 import json
 import os
 import sys
-import traceback
 
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
@@ -29,7 +32,6 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-from jax import lax  # noqa: E402
 from jax.sharding import Mesh  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -41,55 +43,17 @@ from lightgbm_tpu.utils.cache import enable_persistent_cache  # noqa: E402
 enable_persistent_cache()   # live-config bootstrap; see utils/cache.py
 
 from lightgbm_tpu.grower import FeatureMeta, GrowerConfig  # noqa: E402
+# the interception machinery (lax monkeypatch, byte counting, the
+# per-split/per-tree stack classifier) lives in the telemetry subsystem
+# now; this script only drives it and formats the tables
+from lightgbm_tpu.obs import collectives as obs_coll  # noqa: E402
 from lightgbm_tpu.parallel.learner import (  # noqa: E402
     make_distributed_grower)
 from lightgbm_tpu.parallel.mesh import make_2d_mesh  # noqa: E402
 
-RECORDS = []
-
-
-def _nbytes(tree):
-    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(
-        tree) if hasattr(x, "dtype"))
-
-
-def _record(op, args_tree, axis):
-    stack = traceback.extract_stack()
-    site = next((f"{os.path.basename(f.filename)}:{f.lineno}"
-                 for f in reversed(stack)
-                 if "lightgbm_tpu" in f.filename), "?")
-    per_split = any(f.name == "body" and "grower.py" in f.filename
-                    for f in stack)
-    RECORDS.append({
-        "op": op, "bytes": _nbytes(args_tree), "axis": str(axis),
-        "site": site, "per_split": per_split})
-
-
-_orig = {}
-
-
-def _install():
-    def wrap(name):
-        fn = getattr(lax, name)
-        _orig[name] = fn
-
-        def inner(x, axis_name, **kw):
-            _record(name, x, axis_name)
-            return fn(x, axis_name, **kw)
-        return inner
-    for name in ("psum", "pmax", "pmin", "all_gather"):
-        setattr(lax, name, wrap(name))
-
-
-def _uninstall():
-    for name, fn in _orig.items():
-        setattr(lax, name, fn)
-
 
 def audit(learner, n_feat, max_bin, num_leaves=255, top_k=20):
     """Trace the distributed grower once and bucket its collectives."""
-    global RECORDS
-    RECORDS = []
     n_rows = 8 * 1024          # shape-irrelevant for collective payloads
     cfg = GrowerConfig(num_leaves=num_leaves, max_bin=max_bin,
                        min_data_in_leaf=1, hist_method="segment")
@@ -101,8 +65,7 @@ def audit(learner, n_feat, max_bin, num_leaves=255, top_k=20):
         axis = "feature" if learner == "feature" else "data"
         mesh = Mesh(np.array(devs), (axis,))
     f_pad = -(-n_feat // 8) * 8      # feature learner: multiple of shards
-    _install()
-    try:
+    with obs_coll.intercept() as records:
         fn = make_distributed_grower(cfg, mesh, learner, top_k=top_k)
         bins = jax.ShapeDtypeStruct((n_rows, f_pad), jnp.uint8)
         w = jax.ShapeDtypeStruct((n_rows,), jnp.float32)
@@ -113,10 +76,8 @@ def audit(learner, n_feat, max_bin, num_leaves=255, top_k=20):
             is_categorical=jax.ShapeDtypeStruct((f_pad,), jnp.bool_))
         fv = jax.ShapeDtypeStruct((f_pad,), jnp.bool_)
         fn.lower(bins, w, w, w, meta, fv)
-    finally:
-        _uninstall()
-    per_split = [r for r in RECORDS if r["per_split"]]
-    per_tree = [r for r in RECORDS if not r["per_split"]]
+    per_split = [r for r in records if r["per_split"]]
+    per_tree = [r for r in records if not r["per_split"]]
     # the per-split classifier matches a stack frame literally named
     # 'body' inside grower.py; data/voting MUST issue per-split psums, so
     # an empty set means the grower's while-loop body function was
@@ -125,8 +86,9 @@ def audit(learner, n_feat, max_bin, num_leaves=255, top_k=20):
     if learner in ("data", "voting") and not per_split:
         raise AssertionError(
             f"{learner} learner traced 0 per-split collectives: the "
-            "'body' stack-frame classifier in _record() no longer "
-            "matches grower.py's while-loop body function")
+            "'body' stack-frame classifier in obs.collectives."
+            "classify_site() no longer matches grower.py's while-loop "
+            "body function")
     return {
         "learner": learner, "features": n_feat, "max_bin": max_bin,
         "num_leaves": num_leaves,

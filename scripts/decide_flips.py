@@ -4,7 +4,11 @@ Mechanizes the PERF.md playbook: each A/B artifact is compared against its
 matched baseline (the 1M headline, except the sparse packing A/B which is
 judged against bench_sparse.json), flagged WIN/LOSE/NOISE with the >=5%
 criterion.  Decisions require clean TPU numbers on BOTH sides — degraded
-or CPU-fallback artifacts never decide a TPU default.  Decisions still
+or CPU-fallback artifacts never decide a TPU default, and an artifact
+whose telemetry-observed kernel identity (bench.py's "telemetry" block,
+the lightgbm_tpu.obs dispatch counters) disagrees with its rung label is
+rejected the same way: a tpu+pallas rung that actually ran einsum must
+never decide anything.  Decisions still
 land as code edits (boosting.py auto-resolution block) — this script only
 reads.
 
@@ -67,10 +71,31 @@ def platform(d):
     return "tpu" if "(tpu" in m else "cpu" if "(cpu" in m else "?"
 
 
+def label_kernel(d):
+    """Kernel named by the rung LABEL (the metric string)."""
+    m = d.get("metric", "")
+    for k in ("fused", "pallas"):
+        if f", {k}" in m:
+            return k
+    return None
+
+
+def observed_kernel(d):
+    """Kernel identity the bench child's telemetry actually observed
+    (lightgbm_tpu.obs dispatch counters), when the artifact carries it."""
+    return (d.get("telemetry") or {}).get("observed_kernel")
+
+
 def clean_tpu(d):
-    """Only an undegraded on-chip pallas number may decide a TPU default."""
-    return (d is not None and platform(d) == "tpu"
-            and "degraded" not in d and d.get("value", 0) > 0)
+    """Only an undegraded on-chip number whose telemetry-observed kernel
+    identity agrees with its label may decide a TPU default."""
+    if (d is None or platform(d) != "tpu" or "degraded" in d
+            or d.get("kernel_mismatch") or d.get("value", 0) <= 0):
+        return False
+    obs, lab = observed_kernel(d), label_kernel(d)
+    # telemetry-era artifacts must agree with their label; pre-telemetry
+    # artifacts (no "telemetry" block) keep deciding as before
+    return obs is None or lab is None or obs == lab
 
 
 def main():
@@ -80,8 +105,10 @@ def main():
         print("no headline bench in", cap)
         return
     deciding = clean_tpu(head)
+    obs = observed_kernel(head)
     print(f"headline: {head['value']} trees/s ({platform(head)}"
-          f"{' DEGRADED' if 'degraded' in head else ''}) "
+          f"{' DEGRADED' if 'degraded' in head else ''}"
+          f"{f', observed kernel {obs}' if obs else ''}) "
           f"vs_baseline={head.get('vs_baseline')} link={head.get('link')}")
     if not deciding:
         print("headline is not a clean TPU number -> NO flip decisions "
@@ -105,6 +132,9 @@ def main():
         base = head if base_name is None else load(
             os.path.join(cap, base_name))
         flags = " DEGRADED" if "degraded" in d else ""
+        ok, lk = observed_kernel(d), label_kernel(d)
+        if d.get("kernel_mismatch") or (ok and lk and ok != lk):
+            flags += f" KERNEL-MISMATCH(label {lk}, observed {ok})"
         if not deciding or not clean_tpu(d) or not clean_tpu(base):
             print(f"{fname:34} {d['value']:>9} {'—':>8}  "
                   f"platform {platform(d)}{flags}: not a clean TPU pair, "

@@ -1,6 +1,7 @@
 #!/bin/bash
 # Round-5 capture playbook, priority-ordered per the round-4 verdict:
 #   1. headline bench (the driver artifact has missed four rounds — bank it)
+#      + BENCH_TRACE telemetry trace per rung (docs/OBSERVABILITY.md)
 #   2. microprobe (name the ~3.3 ms/split residual; VERDICT #2)
 #   3. ordered_bins+sort combined A/B (the two big structural flips at once)
 #   4. compact-partition A/B (lowering-proven offline; biggest partition win)
@@ -57,9 +58,15 @@ alive_or_abort() {
 }
 
 echo "== headline bench 1M (current defaults) ==" | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_1m.jsonl" \
 BENCH_TREES=10 BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_1m.json" | tee -a "$OUT/log.txt"
+# per-phase/per-kernel telemetry report for the headline rung (the trace
+# file is written by the measured child; decide_flips reads the observed
+# kernel identity straight out of bench_1m.json's telemetry block)
+timeout 300 python -m lightgbm_tpu.obs "$OUT/trace_1m.jsonl" \
+    > "$OUT/trace_1m.md" 2>> "$OUT/log.txt" || true
 echo "jax_cache entries: $(ls .jax_cache 2>/dev/null | wc -l)" \
     | tee -a "$OUT/log.txt"   # nonzero growth => TPU executables persist
 snap "headline bench"
@@ -78,6 +85,7 @@ echo "== gen-1 forced A/B (fused rung dropped; headline pairs with this) ==" \
 # the default ladder tries tpu+fused first, so bench_1m.json IS the gen-2
 # number when the kernel lowers; this stage forces the gen-1 rung for the
 # direct A/B pair (decide_flips: pallas_fused auto->on if fused wins >=5%)
+BENCH_TRACE="$OUT/trace_1m_gen1.jsonl" \
 BENCH_TREES=6 BENCH_FUSED=0 BENCH_STAGE_TIMEOUT=1200 timeout 1500 \
     python bench.py > "$OUT/bench_1m_gen1.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_1m_gen1.json" | tee -a "$OUT/log.txt"
@@ -86,6 +94,7 @@ snap "gen-1 forced A/B"
 alive_or_abort "gen-1 A/B"
 echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
     | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_1m_ordered_sort.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on,partition_impl=sort \
     BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m_ordered_sort.json" 2>> "$OUT/log.txt"
@@ -97,10 +106,12 @@ echo "== compact-partition Mosaic gate + A/B bench ==" | tee -a "$OUT/log.txt"
 if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
         "tests/test_tpu.py::test_pallas_compact_compiles_and_matches_on_tpu" \
         -q >> "$OUT/log.txt" 2>&1; then
+    BENCH_TRACE="$OUT/trace_1m_compact.jsonl" \
     BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact \
         BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
         > "$OUT/bench_1m_compact.json" 2>> "$OUT/log.txt"
     cat "$OUT/bench_1m_compact.json" | tee -a "$OUT/log.txt"
+    BENCH_TRACE="$OUT/trace_1m_compact_ordered.jsonl" \
     BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact,ordered_bins=on \
         BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
         > "$OUT/bench_1m_compact_ordered.json" 2>> "$OUT/log.txt"
@@ -119,6 +130,7 @@ echo "== nibble kernel Mosaic gate + A/B bench ==" | tee -a "$OUT/log.txt"
 if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
         "tests/test_tpu.py::test_pallas_nibble_compiles_on_tpu" \
         -q >> "$OUT/log.txt" 2>&1; then
+    BENCH_TRACE="$OUT/trace_1m_nibble.jsonl" \
     BENCH_TREES=6 BENCH_EXTRA_PARAMS=pallas_hist_impl=nibble \
         BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
         > "$OUT/bench_1m_nibble.json" 2>> "$OUT/log.txt"
@@ -133,6 +145,7 @@ fi
 alive_or_abort "nibble"
 echo "== bench 63-bin (the reference's own GPU benchmark setting) ==" \
     | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_1m_63bin.jsonl" \
 BENCH_TREES=10 BENCH_MAX_BIN=63 BENCH_STAGE_TIMEOUT=1200 \
     timeout 1500 python bench.py \
     > "$OUT/bench_1m_63bin.json" 2>> "$OUT/log.txt"
@@ -141,6 +154,7 @@ snap "63-bin bench"
 
 alive_or_abort "63-bin"
 echo "== FULL Higgs 10.5M x 28 (north-star shape) ==" | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_higgs_full.jsonl" \
 BENCH_ROWS=10500000 BENCH_TREES=3 BENCH_STAGE_TIMEOUT=2400 \
     timeout 2700 python bench.py \
     > "$OUT/bench_higgs_full.json" 2>> "$OUT/log.txt"
@@ -149,6 +163,7 @@ snap "full Higgs 10.5M"
 
 alive_or_abort "full Higgs"
 echo "== ordered_bins A/B (attribution) ==" | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_1m_ordered.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on \
     BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m_ordered.json" 2>> "$OUT/log.txt"
@@ -157,6 +172,7 @@ snap "ordered_bins A/B"
 
 alive_or_abort "ordered A/B"
 echo "== partition_impl=sort A/B (attribution) ==" | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_1m_sortpart.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=sort \
     BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m_sortpart.json" 2>> "$OUT/log.txt"
@@ -165,6 +181,7 @@ snap "sort-partition A/B"
 
 alive_or_abort "sort A/B"
 echo "== gather_words A/B (words off) ==" | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_1m_nowords.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
     BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m_nowords.json" 2>> "$OUT/log.txt"
@@ -174,6 +191,7 @@ snap "gather_words A/B"
 alive_or_abort "gather_words A/B"
 echo "== gather_panel A/B (weights folded into the word gather) ==" \
     | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_1m_nopanel.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_panel=off \
     BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m_nopanel.json" 2>> "$OUT/log.txt"
@@ -183,6 +201,7 @@ snap "gather_panel A/B"
 alive_or_abort "gather_panel A/B"
 echo "== bucket_scheme=pow15 A/B (1.5x buckets, less padding) ==" \
     | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_1m_pow15.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=bucket_scheme=pow15 \
     BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
     > "$OUT/bench_1m_pow15.json" 2>> "$OUT/log.txt"
@@ -198,6 +217,7 @@ snap "on-chip tier"
 
 alive_or_abort "on-chip tier"
 echo "== bench wide (Epsilon-shaped) ==" | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_wide.jsonl" \
 BENCH_ROWS=200000 BENCH_ROWS_CPU=200000 BENCH_FEATURES=2000 \
     BENCH_TREES=5 BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
     > "$OUT/bench_wide.json" 2>> "$OUT/log.txt"
@@ -206,12 +226,14 @@ snap "wide bench"
 
 alive_or_abort "wide bench"
 echo "== bench sparse (EFB + nibble packing) ==" | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_sparse.jsonl" \
 BENCH_ROWS=1000000 BENCH_ROWS_CPU=1000000 BENCH_SPARSITY=0.9 \
     BENCH_FEATURES=100 BENCH_TREES=5 \
     BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
     > "$OUT/bench_sparse.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_sparse.json" | tee -a "$OUT/log.txt"
 
+BENCH_TRACE="$OUT/trace_sparse_nopack.jsonl" \
 BENCH_ROWS=1000000 BENCH_ROWS_CPU=1000000 BENCH_SPARSITY=0.9 \
     BENCH_FEATURES=100 BENCH_TREES=5 \
     BENCH_EXTRA_PARAMS=enable_bin_packing=false \

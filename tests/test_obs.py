@@ -1,0 +1,327 @@
+"""Telemetry subsystem (lightgbm_tpu.obs): spans, counters, collectives,
+report CLI, and the honesty checks built on them."""
+import importlib
+import importlib.util
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import collectives as obs_coll
+from lightgbm_tpu.obs import report as obs_report
+from lightgbm_tpu.obs import trace as obs_trace
+from lightgbm_tpu.obs.counters import counters
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_xy(n=500, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X @ rng.randn(f) > 0).astype(np.float32)
+    return X, y
+
+
+def _train(trace_path=None, extra=None, rounds=2):
+    X, y = _make_xy()
+    params = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+              "verbose": -1}
+    if trace_path is not None:
+        params["trace_path"] = trace_path
+    params.update(extra or {})
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    return lgb.train(params, ds, num_boost_round=rounds, verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def traced_training(tmp_path_factory):
+    """One 2-iteration CPU training with a Chrome-trace (.json) output;
+    returns (path, counter snapshot taken right after training)."""
+    path = str(tmp_path_factory.mktemp("obs") / "train_trace.json")
+    _train(trace_path=path)
+    return path, counters.snapshot()
+
+
+# ---------------------------------------------------------------- tracer core
+
+
+def test_span_nesting_and_chrome_json(tmp_path):
+    tr = obs_trace.Tracer(str(tmp_path / "t.json"))
+    with tr.span("outer", kind="test"):
+        with tr.span("inner"):
+            pass
+    out = tr.write()
+    with open(out) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    by_name = {e["name"]: e for e in events if e.get("ph") == "X"}
+    assert set(by_name) == {"outer", "inner"}
+    outer, inner = by_name["outer"], by_name["inner"]
+    # X events carry microsecond ts/dur and pid/tid; nesting is expressed
+    # through ts containment (how Chrome rebuilds the flame graph)
+    for e in (outer, inner):
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"kind": "test"}
+    # the file is self-contained: the counter snapshot rides as the final
+    # telemetry.summary event
+    assert events[-1]["name"] == "telemetry.summary"
+    assert events[-1]["args"]["kind"] == "counters"
+
+
+def test_jsonl_output_and_partial_tolerance(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    tr = obs_trace.Tracer(p)
+    with tr.span("a"):
+        pass
+    tr.instant("mark", reason="x")
+    tr.write()
+    events = obs_report.load_events(p)
+    assert {"a", "mark"} <= {e["name"] for e in events}
+    # a torn tail line (killed child) must not break parsing
+    with open(p, "a") as f:
+        f.write('{"name": "torn')
+    events2 = obs_report.load_events(p)
+    assert len(events2) == len(events)
+
+
+def test_disabled_tracer_is_allocation_free():
+    obs_trace.stop()          # ensure the module default state
+    t = obs_trace.get_tracer()
+    assert t is obs_trace.NULL_TRACER and not t.enabled
+    # the disabled fast path hands back ONE shared context manager —
+    # no per-span allocation in the hot loop
+    assert t.span("a", x=1) is t.span("b") is obs_trace.NULL_SPAN
+    t.instant("nope")
+    t.summary("nope", {})
+    assert t.events() == []
+
+
+def test_phase_timers_feed_the_tracer_sink():
+    from lightgbm_tpu.utils.timer import PhaseTimers
+    with obs_trace.tracing() as tr:
+        t = PhaseTimers()
+        with t.phase("zz_phase"):
+            pass
+        t.report("zz timers")
+        events = tr.events()
+    assert any(e["name"] == "zz_phase" and e["ph"] == "X" for e in events)
+    summaries = [e for e in events if e["name"] == "telemetry.summary"]
+    assert any(e["args"]["kind"] == "zz timers"
+               and "zz_phase" in e["args"]["payload"]["seconds"]
+               for e in summaries)
+    assert obs_trace.get_tracer() is obs_trace.NULL_TRACER
+
+
+# ------------------------------------------------------------ training spans
+
+
+def test_cpu_training_emits_iteration_and_split_span_tree(traced_training):
+    path, _ = traced_training
+    events = obs_report.load_events(path)
+    x_names = [e["name"] for e in events if e.get("ph") == "X"]
+    # per-iteration spans from boosting, per-phase from the timers sink,
+    # per-split (trace-time) spans from the grower
+    for name in ("train", "iteration", "boosting", "tree", "score",
+                 "histogram", "split_find", "partition"):
+        assert name in x_names, f"missing span {name!r} in {sorted(set(x_names))}"
+    assert x_names.count("iteration") == 2
+    # iteration spans nest inside the train span
+    train_ev = next(e for e in events if e["name"] == "train")
+    for it in (e for e in events if e["name"] == "iteration"):
+        assert train_ev["ts"] <= it["ts"] + 1e-3
+        assert it["ts"] + it["dur"] <= train_ev["ts"] + train_ev["dur"] + 1e-3
+    # the grower's split spans carry the call-site tag
+    hist_sites = {e.get("args", {}).get("site")
+                  for e in events if e["name"] == "histogram"}
+    assert {"root", "split"} <= hist_sites
+
+
+def test_report_renders_phase_and_kernel_tables(traced_training):
+    path, _ = traced_training
+    text = obs_report.render(path)
+    assert "Per-phase spans" in text
+    assert "Per-kernel dispatch identity" in text
+    assert "iteration" in text
+    # CPU default histogram path is segment — the observed identity line
+    assert "Observed histogram kernel identity:** `segment`" in text
+
+
+def test_cli_round_trips_a_training_trace(traced_training):
+    path, _ = traced_training
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, "-m", "lightgbm_tpu.obs", path],
+                       capture_output=True, text=True, cwd=ROOT, env=env,
+                       timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Per-phase spans" in r.stdout
+    assert "iteration" in r.stdout
+    r2 = subprocess.run([sys.executable, "-m", "lightgbm_tpu.obs", "--json",
+                         path], capture_output=True, text=True, cwd=ROOT,
+                        env=env, timeout=240)
+    assert r2.returncode == 0
+    doc = json.loads(r2.stdout)
+    assert any(p["span"] == "iteration" for p in doc["phases"])
+
+
+# ------------------------------------------------------------------- counters
+
+
+def test_counter_registry_resets_between_trainings(tmp_path):
+    _train(extra={"telemetry": True})
+    first = counters.get("hist_dispatch")
+    assert first and sum(first.values()) > 0
+    _train(extra={"telemetry": True})
+    second = counters.get("hist_dispatch")
+    # identical training => identical trace-time dispatch counts; without
+    # the per-training reset the second run would accumulate to ~2x
+    assert second == first
+
+
+def test_dispatch_identity_einsum_vs_interpret_pallas():
+    from lightgbm_tpu.ops.histogram import subset_histogram
+    rng = np.random.RandomState(3)
+    rows = rng.randint(0, 16, size=(256, 8)).astype(np.uint8)
+    g = rng.randn(256).astype(np.float32)
+    h = np.abs(rng.randn(256)).astype(np.float32)
+    c = np.ones(256, np.float32)
+
+    counters.reset()
+    h_e = subset_histogram(rows, g, h, c, 16, method="einsum", site="t")
+    assert counters.get("hist_dispatch") == {
+        "interpret=False,method=einsum,site=t": 1}
+
+    counters.reset()
+    h_p = subset_histogram(rows, g, h, c, 16, method="pallas",
+                           interpret=True, site="t")
+    assert counters.observed_kernel() == "pallas"
+    assert counters.get("hist_dispatch") == {
+        "interpret=True,method=pallas,site=t": 1}
+    # the kernel FORM resolved under method=pallas is counted too
+    assert counters.get("pallas_impl") == {"impl=onehot": 1}
+    # pallas accumulates in bf16 hi/lo pairs (~f32 accuracy, not exact)
+    np.testing.assert_allclose(np.asarray(h_e), np.asarray(h_p),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_observed_kernel_matches_hist_method():
+    _train(extra={"telemetry": True})                      # CPU default
+    assert counters.observed_kernel() == "segment"
+    _train(extra={"telemetry": True, "cpu_hist_method": "einsum"})
+    assert counters.observed_kernel() == "einsum"
+
+
+# ---------------------------------------------------------------- collectives
+
+
+def test_collectives_intercept_records_traced_psum():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lightgbm_tpu.parallel.learner import _CHECK_KW, shard_map
+    from jax import lax
+    mesh = Mesh(np.array(jax.devices()[:2]), ("d",))
+
+    def f(x):
+        return lax.psum(x, "d")
+
+    sm = shard_map(f, mesh=mesh, in_specs=(P("d"),), out_specs=P(),
+                   **{_CHECK_KW: False})
+    counters.reset()
+    with obs_coll.intercept(count=True) as records:
+        jax.jit(sm).lower(jax.ShapeDtypeStruct((8,), jnp.float32))
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["op"] == "psum" and rec["axis"] == "d"
+    assert rec["bytes"] == 4 * 4          # local shard: 4 rows x f32
+    assert rec["per_split"] is False
+    assert counters.total("collective_calls") == 1
+    # interception is transactional: lax is restored afterwards
+    assert lax.psum is not records and "wrap" not in repr(lax.psum)
+
+
+def test_distributed_strategies_count_collectives():
+    """Tracing the data-parallel grower populates the collective counters
+    (the runtime accounting parallel/learner.py feeds via note_collective)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig
+    from lightgbm_tpu.parallel.learner import make_distributed_grower
+    counters.reset()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    cfg = GrowerConfig(num_leaves=4, max_bin=15, min_data_in_leaf=1,
+                       hist_method="segment")
+    fn = make_distributed_grower(cfg, mesh, "data")
+    bins = jax.ShapeDtypeStruct((1024, 8), jnp.uint8)
+    w = jax.ShapeDtypeStruct((1024,), jnp.float32)
+    meta = FeatureMeta(
+        num_bin=jax.ShapeDtypeStruct((8,), jnp.int32),
+        missing_type=jax.ShapeDtypeStruct((8,), jnp.int32),
+        default_bin=jax.ShapeDtypeStruct((8,), jnp.int32),
+        is_categorical=jax.ShapeDtypeStruct((8,), jnp.bool_))
+    fv = jax.ShapeDtypeStruct((8,), jnp.bool_)
+    fn.lower(bins, w, w, w, meta, fv)
+    calls = counters.get("collective_calls")
+    assert any("site=reduce_hist" in k for k in calls)
+    assert any("site=reduce_scalar" in k for k in calls)
+    assert counters.total("collective_bytes") > 0
+
+
+# ------------------------------------------------------- honesty + utilities
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_decide_flips_rejects_kernel_identity_mismatch():
+    df = _load_script("decide_flips")
+    base = {"metric": "higgs-like 1000k x28 ... (tpu, fused)", "value": 1.2}
+    assert df.clean_tpu(dict(base, telemetry={"observed_kernel": "fused"}))
+    # pre-telemetry artifacts keep deciding (no evidence either way)
+    assert df.clean_tpu(dict(base))
+    # the child's mismatch flag vetoes the artifact
+    assert not df.clean_tpu(dict(base, kernel_mismatch=True,
+                                 degraded="kernel identity mismatch"))
+    # telemetry disagreeing with the rung label vetoes even without flags
+    assert not df.clean_tpu(dict(base,
+                                 telemetry={"observed_kernel": "pallas"}))
+    pallas = {"metric": "... (tpu, pallas)", "value": 1.0,
+              "telemetry": {"observed_kernel": "einsum"}}
+    assert not df.clean_tpu(pallas)
+    assert df.label_kernel(base) == "fused"
+    assert df.observed_kernel(pallas) == "einsum"
+
+
+def test_log_reimport_never_double_attaches_handlers():
+    from lightgbm_tpu.utils import log as log_mod
+    logger = logging.getLogger("lightgbm_tpu")
+
+    def owned():
+        return [h for h in logger.handlers
+                if getattr(h, "_lightgbm_tpu_owned", False)]
+
+    assert len(owned()) == 1
+    importlib.reload(log_mod)
+    assert len(owned()) == 1
+    # even with a foreign handler attached first (pytest's logging plugin
+    # pattern), a reload must neither skip nor duplicate ours
+    foreign = logging.NullHandler()
+    logger.addHandler(foreign)
+    try:
+        importlib.reload(log_mod)
+        assert len(owned()) == 1
+    finally:
+        logger.removeHandler(foreign)
