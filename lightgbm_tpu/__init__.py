@@ -8,6 +8,7 @@ layer (binning, parsing, model IO) mirrors the reference's semantics so
 models and APIs interoperate.
 """
 from .basic import Booster, Dataset
+from .boosting import NonFiniteError
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
@@ -20,7 +21,7 @@ __version__ = "0.1.0"
 __all__ = [
     "Booster", "Dataset", "Config", "train", "cv",
     "early_stopping", "print_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException",
+    "reset_parameter", "EarlyStopException", "NonFiniteError",
     "plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",
 ]
 

@@ -36,9 +36,15 @@ from .objectives import Objective, create_objective, parse_objective_string
 from .predictor import (Predictor, predict_binned_leaf, tree_scores_binned,
                         trees_scores_binned)
 from .tree import Tree
+from .utils import faults as faults_mod
 from .utils import log
 from .utils.random import make_rng, sample_k
 from .utils.timer import PhaseTimers
+
+
+class NonFiniteError(RuntimeError):
+    """A gradient/hessian/leaf value went non-finite and the configured
+    ``nonfinite_policy`` could not (or was asked not to) recover."""
 
 
 class _ValidSet:
@@ -64,6 +70,10 @@ class GBDT:
     # DART reads/mutates prior trees every iteration and RF feeds host
     # gradients; both stay on the synchronous path
     pipeline_supported = True
+    # nonfinite_policy=rollback discards a poisoned iteration via the
+    # rollback arithmetic; DART's drop/normalize bookkeeping cannot be
+    # partially unwound, so it escalates to raise instead
+    rollback_safe = True
 
     def __init__(self, config: Config, train_set: Optional[TrainingData] = None,
                  objective: Optional[Objective] = None):
@@ -78,6 +88,13 @@ class GBDT:
         self._pipeline_depth = 3
         self._stopped_no_split = False
         self._iter_had_split = False
+        # non-finite guard bookkeeping (docs/ROBUSTNESS.md): one structured
+        # event per tripped iteration; a second trip at the SAME iteration
+        # under rollback means the non-finite source is persistent
+        self._nf_policy = config.nonfinite_policy
+        self._nf_event_iter: Optional[int] = None
+        self._nf_rolled_iter: Optional[int] = None
+        self._score_stash = None   # (iter, scores, [valid scores]) refs
         self.models: List[Tree] = []
         self.timers = PhaseTimers()   # TIMETAG analogue (gbdt.cpp:22-64)
         self.iter_ = 0
@@ -126,7 +143,12 @@ class GBDT:
             keep = 0            # everything still pending must be reverted
         while self._pending and len(self._pending) > keep:
             rec = self._pending.pop(0)
-            host = jax.device_get(rec["arrays"])
+            # the non-finite flags ride the SAME batched device_get the
+            # drain already does — no extra host<->device synchronization
+            host, nf_ok, gh_ok = jax.device_get(
+                (rec["arrays"], rec["nf_ok"], rec["gh_ok"]))
+            if not bool(nf_ok):
+                self._nonfinite_at_drain(int(rec["iter"]), bool(gh_ok))
             tree = Tree.from_arrays(host, self.train_set.used_features,
                                     self.train_set.bin_mappers,
                                     self._num_bin_host)
@@ -232,8 +254,16 @@ class GBDT:
             min_cat_smooth=cfg.min_cat_smooth,
             max_cat_smooth=cfg.max_cat_smooth)
         self._setup_grower(cfg, train)
+        # rollback must act BEFORE the next iteration trains on poisoned
+        # scores, so it forces synchronous tree materialization; the cheap
+        # default (raise) keeps the pipeline and detects at drain time
         self._pipeline = (cfg.pipeline_trees and self.pipeline_supported
-                          and not self._multiproc)
+                          and not self._multiproc
+                          and cfg.nonfinite_policy != "rollback")
+        if (cfg.pipeline_trees and self.pipeline_supported
+                and not self._multiproc and not self._pipeline):
+            log.info("nonfinite_policy=rollback forces synchronous tree "
+                     "materialization (pipeline_trees disabled)")
 
         self.objective.init(train.metadata, n)
         self.num_class = self.objective.num_tree_per_iteration
@@ -604,6 +634,16 @@ class GBDT:
                 and not self.boost_from_average_):
             self._boost_from_average()
 
+        # score arrays are immutable jax values, so holding the
+        # iteration-start REFERENCES is a zero-copy undo point: rollback of
+        # this (or the just-finished) iteration restores them bit-exactly,
+        # which arithmetic subtraction cannot do in f32 ((a+b)-b is off by
+        # an ulp for ~half of all inputs) — the invariant
+        # nonfinite_policy=rollback and tests/test_robustness.py depend on
+        if self.rollback_safe:
+            self._score_stash = (self.iter_, self.scores,
+                                 [vs.scores for vs in self.valid_sets])
+
         # pipelined mode never blocks in the loop: every phase is an async
         # dispatch and freshly grown trees drain to host a few iterations
         # late (one batched transfer each).  Synchronous mode blocks each
@@ -624,6 +664,19 @@ class GBDT:
             else:
                 g = jnp.asarray(grad, jnp.float32).reshape(self.num_class, -1)
                 h = jnp.asarray(hess, jnp.float32).reshape(self.num_class, -1)
+            fi = faults_mod.get_faults()
+            if fi.enabled:
+                if fi.fire("nan_grad", int(self.iter_)):
+                    g = g.at[0, 0].set(jnp.nan)
+                if fi.fire("inf_hess", int(self.iter_)):
+                    h = h.at[0, 0].set(jnp.inf)
+            # device-side finiteness flag, fetched later alongside values
+            # the loop already pulls (num_leaves / the drain batch) — the
+            # guard adds no host<->device synchronization of its own
+            gh_ok = jnp.isfinite(g).all() & jnp.isfinite(h).all()
+            if self._nf_policy == "clamp":
+                g = jnp.where(jnp.isfinite(g), g, 0.0)
+                h = jnp.where(jnp.isfinite(h), h, 1.0)
             if not pipeline:
                 jax.block_until_ready((g, h))
         with self.timers.phase("bagging"):
@@ -660,6 +713,7 @@ class GBDT:
                         self._dist_row_vec(h[k] * self._bag_weight),
                         self._dist_row_vec(cnt), self.meta, feat_mask)
                     row_leaf = self._local_rows(row_leaf)
+                nf_ok = gh_ok & jnp.isfinite(arrays.leaf_value).all()
                 if pipeline:
                     # start the host copy NOW; the batched device_get a few
                     # iterations later finds the bytes already landed
@@ -672,7 +726,18 @@ class GBDT:
                         # the local scoring/predict paths see process-local
                         # data
                         arrays = jax.tree.map(np.asarray, arrays)
-                    num_leaves = int(arrays.num_leaves)
+                        num_leaves = int(arrays.num_leaves)
+                        nf_ok_h = bool(np.asarray(nf_ok))
+                        gh_ok_h = bool(np.asarray(gh_ok))
+                    else:
+                        # ONE fetch for the split count AND the guard flags
+                        # (the sync the loop was already paying)
+                        num_leaves, nf_ok_h, gh_ok_h = jax.device_get(
+                            (arrays.num_leaves, nf_ok, gh_ok))
+                        num_leaves = int(num_leaves)
+                    if not bool(nf_ok_h) \
+                            and self._handle_nonfinite(k, bool(gh_ok_h)):
+                        return False    # iteration rolled back; retry next
                     tree = Tree.from_arrays(
                         arrays, self.train_set.used_features,
                         self.train_set.bin_mappers, self._num_bin_host)
@@ -713,7 +778,8 @@ class GBDT:
                         jax.block_until_ready(self.scores)
             if pipeline:
                 self._pending.append(
-                    {"iter": self.iter_, "k": k, "arrays": arrays, "lr": lr})
+                    {"iter": self.iter_, "k": k, "arrays": arrays, "lr": lr,
+                     "nf_ok": nf_ok, "gh_ok": gh_ok})
         self._after_iter()
         self.iter_ += 1
         if pipeline:
@@ -794,21 +860,187 @@ class GBDT:
                                self.feat_info, self.train_set.bin_mappers)
         return s[:self.num_data] if self._row_pad else s
 
+    def _pop_tree_and_revert(self, k: int) -> None:
+        """Pop the last stored tree (class ``k``) and subtract its score
+        contributions from train and valid scores — the unit step of
+        ``rollback_one_iter``, also reused by the non-finite guard's
+        partial same-iteration unwind."""
+        tree = self.models.pop()
+        if tree.num_leaves > 1:
+            tree.shrink(-1.0)
+            self.scores = self.scores.at[k].add(self._train_tree_score(tree))
+            for vs in self.valid_sets:
+                vs.scores = vs.scores.at[k].add(tree_scores_binned(
+                    vs.bins, tree, self.used_feature_index, self.feat_info,
+                    self.train_set.bin_mappers))
+
+    def _stash_usable(self, expect_iter: int) -> bool:
+        stash = getattr(self, "_score_stash", None)
+        return (self.rollback_safe and stash is not None
+                and stash[0] == expect_iter
+                and len(stash[2]) == len(self.valid_sets))
+
+    def _restore_score_stash(self) -> None:
+        _, self.scores, vscores = self._score_stash
+        for vs, s in zip(self.valid_sets, vscores):
+            vs.scores = s
+        self._score_stash = None
+
     def rollback_one_iter(self) -> None:
-        """gbdt.cpp:583-600."""
+        """gbdt.cpp:583-600.
+
+        Rolling back the most recent iteration restores train/valid scores
+        from the iteration-start stash — bit-exact.  Older rollbacks (the
+        stash only covers one step) fall back to the reference's
+        subtract-the-contribution arithmetic, exact up to f32 rounding."""
         if self.iter_ <= 0:
             return
         self._native_pred = None   # model-length alone can't detect this
-        for k in reversed(range(self.num_class)):
-            tree = self.models.pop()
-            if tree.num_leaves > 1:
-                tree.shrink(-1.0)
-                self.scores = self.scores.at[k].add(self._train_tree_score(tree))
-                for vs in self.valid_sets:
-                    vs.scores = vs.scores.at[k].add(tree_scores_binned(
-                        vs.bins, tree, self.used_feature_index, self.feat_info,
-                        self.train_set.bin_mappers))
+        if self._stash_usable(self.iter_ - 1):
+            for _ in range(self.num_class):
+                self.models.pop()
+            self._restore_score_stash()
+        else:
+            self._score_stash = None
+            for k in reversed(range(self.num_class)):
+                self._pop_tree_and_revert(k)
         self.iter_ -= 1
+
+    # ----------------------------------------------------- non-finite guard
+
+    def _nf_event(self, it: int, stage: str, detected: str) -> None:
+        """One structured obs event per tripped iteration (the multiclass
+        loop and the per-tree drain records must not multiply it)."""
+        if self._nf_event_iter == it:
+            return
+        self._nf_event_iter = it
+        obs_counters.inc("nonfinite_trips", policy=self._nf_policy)
+        obs_counters.event("nonfinite", stage=stage, iteration=it,
+                           policy=self._nf_policy, detected=detected)
+        log.warning("Non-finite %s detected at iteration %d "
+                    "(nonfinite_policy=%s)", stage, it, self._nf_policy)
+
+    def _handle_nonfinite(self, k: int, gh_ok: bool) -> bool:
+        """Synchronous-path guard trip for class ``k`` of this iteration
+        (BEFORE the tree is stored or any score update ran).  Returns True
+        when the iteration was rolled back and must be retried."""
+        it = int(self.iter_)
+        stage = "leaf_value" if gh_ok else "grad/hess"
+        self._nf_event(it, stage, detected="iteration")
+        if self._nf_policy == "clamp":
+            # grad/hess were sanitized on device; a non-finite LEAF with
+            # finite inputs means the tree math itself diverged — no safe
+            # clamp exists for that
+            if gh_ok:
+                raise NonFiniteError(
+                    f"non-finite leaf values at iteration {it} (tree {k}) "
+                    "with finite gradients; clamping cannot recover")
+            return False
+        if self._nf_policy == "rollback" and self.rollback_safe:
+            if self._nf_rolled_iter == it:
+                raise NonFiniteError(
+                    f"non-finite {stage} persisted at iteration {it} after "
+                    "rollback — the source is not transient; fix the "
+                    "objective/data or use nonfinite_policy=clamp")
+            self._nf_rolled_iter = it
+            self._native_pred = None
+            # unwind this iteration's already-stored earlier classes:
+            # restore the iteration-start score references (bit-exact) and
+            # drop their trees; arithmetic revert is the fallback
+            if self._stash_usable(it):
+                for _ in range(k):
+                    self.models.pop()
+                self._restore_score_stash()
+            else:
+                for kk in reversed(range(k)):
+                    self._pop_tree_and_revert(kk)
+            log.warning("Rolled back iteration %d (%d earlier class "
+                        "tree(s) unwound); retrying", it, k)
+            return True
+        hint = ("rollback is unavailable for this boosting type; use "
+                "nonfinite_policy=clamp"
+                if self._nf_policy == "rollback" else
+                "set nonfinite_policy=rollback or clamp to recover")
+        raise NonFiniteError(
+            f"non-finite {stage} detected at iteration {it} (tree {k}); "
+            f"{hint}, or fix the objective/data producing it")
+
+    def _nonfinite_at_drain(self, it: int, gh_ok: bool) -> None:
+        """Pipelined-path guard trip, detected at the (late) drain of
+        iteration ``it``'s trees.  Under clamp the device values were
+        already sanitized — this is visibility only; otherwise raise."""
+        stage = "leaf_value" if gh_ok else "grad/hess"
+        self._nf_event(it, stage, detected="drain")
+        if self._nf_policy != "clamp":
+            raise NonFiniteError(
+                f"non-finite {stage} detected at iteration {it} (pipelined "
+                "tree drain); set nonfinite_policy=rollback for prompt "
+                "per-iteration recovery or clamp to sanitize")
+
+    # ------------------------------------------------------------ checkpoint
+
+    def checkpoint_state(self) -> dict:
+        """Bit-exact resumable training state (lightgbm_tpu.checkpoint):
+        everything ``train_one_iter`` reads that is not derivable from the
+        config + dataset — device score matrices, RNG streams, the live
+        bagging subset/mask, and iteration bookkeeping."""
+        self._drain_pending()
+        st = {
+            "kind": self.sub_model_name,
+            "models": list(self._models),
+            "iter_": self.iter_,
+            "num_init_iteration": self.num_init_iteration,
+            "boost_from_average_": self.boost_from_average_,
+            "best_iteration": self.best_iteration,
+            "scores": np.asarray(self.scores),
+            "valid_scores": [np.asarray(vs.scores) for vs in self.valid_sets],
+            "bag_rng": self._bag_rng.bit_generator.state,
+            "feat_rng": self._feat_rng.bit_generator.state,
+            "bagging_on": getattr(self, "_bagging_on", False),
+            "bag_weight": np.asarray(self._bag_weight),
+            "bag_cnt": np.asarray(self._bag_cnt),
+            "subset": (None if self._subset_state is None else
+                       {"idx": np.asarray(self._subset_state[1]),
+                        "w": np.asarray(self._subset_state[2])}),
+            "learning_rate": self.config.learning_rate,
+        }
+        return st
+
+    def load_checkpoint_state(self, st: dict) -> None:
+        """Inverse of :meth:`checkpoint_state`; requires a booster built
+        on the same dataset/params (the checkpoint carries training state,
+        not the binned data)."""
+        self._pending = []
+        self._models = list(st["models"])
+        self.iter_ = int(st["iter_"])
+        self.num_init_iteration = int(st["num_init_iteration"])
+        self.boost_from_average_ = bool(st["boost_from_average_"])
+        self.best_iteration = st["best_iteration"]
+        self.scores = jnp.asarray(st["scores"])
+        for vs, s in zip(self.valid_sets, st["valid_scores"]):
+            vs.scores = jnp.asarray(s)
+        self._bag_rng = make_rng(0)
+        self._bag_rng.bit_generator.state = st["bag_rng"]
+        self._feat_rng = make_rng(0)
+        self._feat_rng.bit_generator.state = st["feat_rng"]
+        self._bagging_on = bool(st["bagging_on"])
+        self._bag_weight = jnp.asarray(st["bag_weight"])
+        self._bag_cnt = jnp.asarray(st["bag_cnt"])
+        if st["subset"] is not None:
+            idx_d = jnp.asarray(st["subset"]["idx"])
+            w_p = np.asarray(st["subset"]["w"])
+            self._subset_state = (
+                jnp.take(self.bins, idx_d, axis=0), idx_d, jnp.asarray(w_p),
+                jnp.asarray((w_p > 0).astype(np.float32)),
+                (jnp.take(self._hist_bins, idx_d, axis=0)
+                 if self._hist_bins is not None else None))
+        else:
+            self._subset_state = None
+        self.config.learning_rate = float(st["learning_rate"])
+        self._stopped_no_split = False
+        self._iter_had_split = False
+        self._score_stash = None
+        self._native_pred = None
 
     # ------------------------------------------------------------------- eval
 
@@ -1039,6 +1271,8 @@ class DART(GBDT):
     just its trees, already normalized)."""
 
     pipeline_supported = False   # reads/shrinks prior trees every iteration
+    rollback_safe = False        # drop/normalize bookkeeping cannot be
+                                 # partially unwound mid-iteration
 
     def __init__(self, config, train_set=None, objective=None):
         super().__init__(config, train_set, objective)
@@ -1128,6 +1362,24 @@ class DART(GBDT):
 
     def _shrinkage_rate(self) -> float:
         return self._shrinkage
+
+    def checkpoint_state(self) -> dict:
+        st = super().checkpoint_state()
+        st["dart"] = {"drop_rng": self._drop_rng.bit_generator.state,
+                      "tree_weight": list(self.tree_weight),
+                      "sum_weight": self.sum_weight,
+                      "shrinkage": self._shrinkage}
+        return st
+
+    def load_checkpoint_state(self, st: dict) -> None:
+        super().load_checkpoint_state(st)
+        d = st.get("dart") or {}
+        if "drop_rng" in d:
+            self._drop_rng = make_rng(0)
+            self._drop_rng.bit_generator.state = d["drop_rng"]
+        self.tree_weight = list(d.get("tree_weight", []))
+        self.sum_weight = float(d.get("sum_weight", 0.0))
+        self._shrinkage = float(d.get("shrinkage", self.config.learning_rate))
 
     def _normalize(self) -> None:
         """dart.hpp:141-180 (see train_one_iter comment for the algebra)."""

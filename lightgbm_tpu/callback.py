@@ -79,10 +79,25 @@ def reset_parameter(**kwargs) -> Callable:
 
 
 def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
+    """Stop when no metric improved for ``stopping_rounds`` iterations.
+
+    A NaN metric value never compares as an improvement (every comparison
+    against NaN is False), so a metric that goes NaN simply stops the
+    improvement clock: the best score/iteration stay at the last *finite*
+    best and training early-stops once the patience runs out — it never
+    records NaN as a best or crashes (pinned by
+    ``tests/test_robustness.py``).
+
+    The returned callback exposes ``checkpoint_state()`` /
+    ``restore_state(state)`` so snapshot checkpoints
+    (:mod:`lightgbm_tpu.checkpoint`) can carry the best-score bookkeeping
+    across a crash-resume without divergence.
+    """
     best_score: List[float] = []
     best_iter: List[int] = []
     best_score_list: List = []
     cmp_op: List[Callable] = []
+    pending_restore: List[Dict] = []
 
     def _init(env: CallbackEnv) -> None:
         if not env.evaluation_result_list:
@@ -104,8 +119,14 @@ def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
     def _callback(env: CallbackEnv) -> None:
         if not cmp_op:
             _init(env)
+        if pending_restore:
+            st = pending_restore.pop()
+            best_score[:] = st["best_score"]
+            best_iter[:] = st["best_iter"]
+            best_score_list[:] = st["best_score_list"]
         for i, item in enumerate(env.evaluation_result_list):
             score = item[2]
+            # NaN fails both cmp directions: never an improvement
             if cmp_op[i](score, best_score[i]):
                 best_score[i] = score
                 best_iter[i] = env.iteration
@@ -115,5 +136,17 @@ def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
                     log.info("Early stopping, best iteration is: [%d]",
                              best_iter[i] + 1)
                 raise EarlyStopException(best_iter[i], best_score_list[i])
+
+    def _checkpoint_state() -> Dict:
+        return {"best_score": list(best_score),
+                "best_iter": list(best_iter),
+                "best_score_list": list(best_score_list)}
+
+    def _restore_state(state: Dict) -> None:
+        # applied lazily on the next call, AFTER _init sized the lists
+        pending_restore[:] = [dict(state)]
+
     _callback.order = 30
+    _callback.checkpoint_state = _checkpoint_state
+    _callback.restore_state = _restore_state
     return _callback

@@ -51,7 +51,11 @@ def run_train(cfg: Config, params: Dict[str, str]) -> None:
                        num_boost_round=cfg.num_iterations,
                        valid_sets=valid_sets, valid_names=valid_names,
                        early_stopping_rounds=cfg.early_stopping_round or None,
-                       verbose_eval=cfg.output_freq if cfg.verbose >= 1 else False)
+                       verbose_eval=cfg.output_freq if cfg.verbose >= 1 else False,
+                       # snapshot_resume=true: a preempted/killed run is
+                       # re-launched with the SAME command line and picks up
+                       # from the latest valid checkpoint (docs/ROBUSTNESS.md)
+                       resume=cfg.snapshot_resume or None)
     booster.save_model(cfg.output_model)
     log.info("Finished training; model saved to %s", cfg.output_model)
 

@@ -205,6 +205,13 @@ class Config:
     input_model: str = ""
     output_result: str = "LightGBM_predict_result.txt"
     snapshot_freq: int = -1
+    snapshot_keep: int = -1        # retain only the K most-recent snapshot
+                                   # checkpoints, pruned after each write
+                                   # (-1 = keep all)
+    snapshot_resume: bool = False  # resume training from the latest VALID
+                                   # snapshot checkpoint of output_model
+                                   # (torn tails fall back to the previous
+                                   # good snapshot; docs/ROBUSTNESS.md)
     profile_dir: str = ""          # write a jax.profiler trace of training here
     trace_path: str = ""           # write a Chrome-trace span file (.json or
                                    # .jsonl) of training here (lightgbm_tpu.obs
@@ -214,6 +221,21 @@ class Config:
     convert_model: str = "gbdt_prediction.cpp"
     convert_model_language: str = ""
 
+    # robustness (docs/ROBUSTNESS.md)
+    nonfinite_policy: str = "raise"  # guard on non-finite grad/hess/leaf
+                                     # values: raise | rollback | clamp.
+                                     # raise fails naming the iteration;
+                                     # rollback discards the poisoned
+                                     # iteration (forces synchronous tree
+                                     # materialization); clamp sanitizes
+                                     # grad->0 / hess->1 on device.  Every
+                                     # trip emits a structured `nonfinite`
+                                     # obs event.
+    fault_inject: str = ""           # deterministic fault-injection spec,
+                                     # e.g. nan_grad@3,torn_checkpoint@4,
+                                     # collective_fail_once (utils/faults.py;
+                                     # also via LGBM_TPU_FAULT_INJECT env)
+
     # distributed (reference NetworkConfig -> JAX mesh knobs)
     num_machines: int = 1
     local_listen_port: int = 12400
@@ -221,6 +243,12 @@ class Config:
     machine_list_file: str = ""
     # TPU additions: how many mesh devices to use per axis; 0 = all available
     mesh_devices: int = 0
+    collective_timeout: float = 120.0  # seconds one host-object collective
+                                       # attempt may block before it is
+                                       # failed and retried (parallel/sync.py)
+    collective_retries: int = 2        # bounded retries with exponential
+                                       # backoff per host-object collective
+                                       # before the error surfaces
 
     # compute backend knobs (TPU analogue of gpu_* params)
     gpu_platform_id: int = -1
@@ -424,6 +452,22 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.bucket_scheme not in ("auto", "pow2", "pow15"):
         log.fatal("bucket_scheme must be auto, pow2, or pow15; got %r",
                   cfg.bucket_scheme)
+    if cfg.nonfinite_policy not in ("raise", "rollback", "clamp"):
+        log.fatal("nonfinite_policy must be raise, rollback, or clamp; "
+                  "got %r", cfg.nonfinite_policy)
+    if cfg.fault_inject:
+        # fail at parse time with the real cause, not at the injection point
+        from .utils.faults import parse_spec
+        try:
+            parse_spec(cfg.fault_inject)
+        except ValueError as e:
+            log.fatal("%s", e)
+    if cfg.collective_timeout <= 0:
+        log.fatal("collective_timeout must be positive; got %r",
+                  cfg.collective_timeout)
+    if cfg.collective_retries < 0:
+        log.fatal("collective_retries must be >= 0; got %d",
+                  cfg.collective_retries)
     if cfg.pallas_hist_impl == "nibble":
         # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
         # axis and tiles (feat_tile * 16) output lanes — reject shapes it

@@ -13,8 +13,10 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import numpy as np
 
 from . import callback as callback_mod
+from . import checkpoint as checkpoint_mod
 from .basic import Booster, Dataset
 from .config import canonicalize_params
+from .utils import faults as faults_mod
 from .utils import log
 
 
@@ -31,8 +33,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
           verbose_eval: Union[bool, int] = True,
           learning_rates: Optional[Union[List[float], Callable]] = None,
           keep_training_booster: bool = True,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """engine.py:18-229 analogue."""
+          callbacks: Optional[List[Callable]] = None,
+          resume: Optional[Union[bool, str]] = None) -> Booster:
+    """engine.py:18-229 analogue.
+
+    ``resume`` (also the ``snapshot_resume`` param): ``True`` auto-detects
+    the latest *valid* ``<output_model>.snapshot_iter_N`` checkpoint (a
+    torn tail falls back to the previous good one) and continues training
+    from it with bit-exact state — final model byte-identical to an
+    uninterrupted run; a string resumes from that explicit checkpoint
+    file.  See docs/ROBUSTNESS.md.
+    """
     params = canonicalize_params(params)
     if "num_iterations" in params:
         num_boost_round = int(params.pop("num_iterations"))
@@ -51,6 +62,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if telemetry_on:
         obs_counters.reset()
         obs_trace.start(trace_path or None)
+    # deterministic fault injection (utils/faults.py): a param-armed plan is
+    # scoped to THIS training; an env-armed plan stays process-wide
+    fault_spec = str(params.get("fault_inject", "") or "")
+    prev_faults = faults_mod.get_faults()
+    if fault_spec:
+        faults_mod.install(fault_spec)
+    # host-object collective budget (parallel/sync.py recovery ladder)
+    from .parallel import sync as sync_mod
+    if params.get("collective_timeout") or params.get("collective_retries") \
+            is not None:
+        sync_mod.configure(
+            timeout=float(params["collective_timeout"])
+            if params.get("collective_timeout") else None,
+            retries=int(params["collective_retries"])
+            if params.get("collective_retries") is not None else None)
     if int(params.get("num_machines", 1)) > 1:
         # multi-host bring-up from config (application.cpp:190-224 analogue)
         from .config import config_from_params
@@ -113,7 +139,41 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
+    snapshot_keep = int(params.get("snapshot_keep", -1) or -1)
     snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
+    single_process = sync_mod.process_count() == 1
+    ckpt_callbacks = cbs_before + cbs_after   # stable capture/restore order
+
+    # ---- resume from the latest valid snapshot (docs/ROBUSTNESS.md) ----
+    if resume is None:
+        resume = params.get("snapshot_resume", False)
+    if isinstance(resume, str):
+        s = resume.strip().lower()
+        if s in ("false", "0", "no", "off", "-", ""):
+            resume = False
+        elif s in ("true", "1", "yes", "on", "+", "auto"):
+            resume = True
+    start_iter = 0
+    if resume:
+        if not single_process:
+            log.warning("snapshot_resume is single-process for now; "
+                        "ignoring (multi-process checkpoint coordination "
+                        "is a ROADMAP item)")
+        else:
+            if isinstance(resume, str):    # explicit checkpoint file
+                _, state = checkpoint_mod.load_snapshot(resume)
+                found = (int(state["iteration"]), resume, state)
+            else:                          # auto-detect; torn tails skipped
+                found = checkpoint_mod.find_latest_valid(snapshot_out)
+            if found is None:
+                log.info("snapshot_resume: no valid snapshot for %s; "
+                         "training from scratch", snapshot_out)
+            else:
+                _, ck_path, state = found
+                start_iter = checkpoint_mod.restore_state(
+                    booster, state, ckpt_callbacks, evals_result)
+                log.info("Resumed training from %s (continuing at "
+                         "iteration %d)", ck_path, start_iter)
 
     # jax.profiler trace of the boosting loop (the reference's TIMETAG deep
     # profile becomes an xprof trace; lightweight counters are always on)
@@ -128,7 +188,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         "train", num_boost_round=num_boost_round)
     try:
         with profile_ctx, train_span:
-            for i in range(num_boost_round):
+            for i in range(start_iter, num_boost_round):
                 for cb in cbs_before:
                     cb(callback_mod.CallbackEnv(
                         model=booster, params=params,
@@ -136,10 +196,6 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         end_iteration=num_boost_round,
                         evaluation_result_list=None))
                 finished = booster.update(fobj=fobj)
-
-                if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
-                    # gbdt.cpp:456-460: periodic model snapshots in training
-                    booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
 
                 evaluation_result_list = []
                 if valid_sets:
@@ -160,8 +216,25 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         booster.best_score.setdefault(
                             item[0], {})[item[1]] = item[2]
                     break
+                if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0 \
+                        and single_process:
+                    # gbdt.cpp:456-460's snapshot cadence, upgraded to an
+                    # atomic resumable checkpoint: model text (still a valid
+                    # model file) + full training state + CRC footer,
+                    # written tmp+fsync+os.replace.  AFTER the callbacks so
+                    # the captured eval/early-stop state matches iteration i.
+                    checkpoint_mod.write_snapshot(
+                        checkpoint_mod.snapshot_path(snapshot_out, i + 1),
+                        booster, i + 1, ckpt_callbacks, evals_result)
+                    if snapshot_keep > 0:
+                        checkpoint_mod.prune_snapshots(snapshot_out,
+                                                       snapshot_keep)
                 if finished:
                     break
+        # drain pipelined tree materialization NOW: deferred guard trips
+        # (non-finite raise) and late no-split rewinds must surface from
+        # train() itself, not from a later .models access
+        booster.inner.models
         if booster.best_iteration <= 0:
             booster.best_iteration = booster.current_iteration()
         booster.inner.timers.report("training phase timers")
@@ -177,9 +250,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 try:
                     obs_counters.gauge("grower_jit_entries",
                                        int(cache_size()))
-                except Exception:
-                    pass
+                except (TypeError, ValueError) as e:
+                    # a gauge is best-effort, but anything beyond a size
+                    # that won't coerce to int is a real bug — let it raise
+                    log.debug("grower_jit_entries gauge unavailable: %s", e)
             obs_trace.stop()
+        if fault_spec:
+            faults_mod.restore(prev_faults)
     return booster
 
 

@@ -40,8 +40,20 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..obs.counters import counters as obs_counters
+from ..utils import faults as faults_mod
 
 NUM_STATS = 3     # (sum_grad, sum_hess, count)
+
+
+def _maybe_inject_hist_fault(method: str, site: str) -> None:
+    """Armed ``hist_fail`` injection point: dispatch (host/trace time)
+    raises deterministically so the error-surface of the hottest op is
+    testable on CPU (utils/faults.py)."""
+    fi = faults_mod.get_faults()
+    if fi.enabled and fi.fire("hist_fail"):
+        raise faults_mod.InjectedFault(
+            f"hist_fail: injected histogram dispatch failure "
+            f"(method={method}, site={site})")
 
 
 def on_tpu() -> bool:
@@ -154,6 +166,7 @@ def subset_histogram_fused(order: jnp.ndarray, panel: jnp.ndarray,
     # and decide_flips verify the label against this counter
     obs_counters.inc("hist_dispatch", method="fused", site=site,
                      interpret=bool(interpret))
+    _maybe_inject_hist_fault("fused", site)
     h6 = hist6_fused(order, panel, start, cnt, n_cols, words_per, num_bins,
                      row_tile=row_tile, num_row_tiles=num_row_tiles,
                      interpret=interpret)
@@ -191,6 +204,7 @@ def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     obs_counters.inc("hist_dispatch",
                      method=("pallas" if method == "fused" else method),
                      site=site, interpret=bool(interpret))
+    _maybe_inject_hist_fault(method, site)
     if method in ("pallas", "fused"):
         from .pallas_hist import subset_histogram_pallas
         return subset_histogram_pallas(rows, g, h, c, num_bins,

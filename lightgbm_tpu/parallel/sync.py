@@ -1,17 +1,63 @@
-"""Host-object synchronization across processes.
+"""Host-object synchronization across processes — hardened.
 
 The reference's distributed ``FindBin`` ships serialized ``BinMapper`` blobs
-through its Bruck allgather (``dataset_loader.cpp:737-816``: each machine
-fits mappers for its feature slice, then ``Network::Allgather`` merges).
-With jax the transport is the distributed runtime's allgather over a
-length-then-payload two-phase pickle — no hand-rolled socket layer.
+through its Bruck allgather (``dataset_loader.cpp:737-816``); with jax the
+transport is the distributed runtime's allgather over a length-then-payload
+two-phase pickle.  Block-distributed GBT work (PAPERS.md) shows workers +
+collectives are exactly where distributed boosting fails in practice, so
+every host-object collective here is wrapped in the same recovery ladder:
+
+* **payload integrity** — each process ships ``[length, crc32]`` alongside
+  its pickle; the receiver verifies every slice and an error names the
+  *offending process index* instead of dying later in ``pickle.loads``;
+* **timeout** — one attempt may block at most ``collective_timeout``
+  seconds (the runtime's allgather has no deadline of its own: a dead peer
+  used to hang the fleet silently);
+* **bounded retry with backoff** — transient failures re-attempt up to
+  ``collective_retries`` times (exponential backoff), each retry counted
+  into the ``collective_retries`` obs counter and recorded as a
+  ``collective_retry`` structured event, so recovery is visible, never
+  silent;
+* **fault injection** — the ``collective_fail`` / ``collective_corrupt``
+  points (:mod:`lightgbm_tpu.utils.faults`) exercise the whole ladder on
+  CPU in tier-1.
+
+``broadcast_object`` is a real rank-0 length-then-payload broadcast: only
+process 0 pickles and ships its object (it used to run a full allgather
+and take element 0 — every process pickled and shipped a payload that was
+thrown away).
 """
 from __future__ import annotations
 
 import pickle
-from typing import Any, List
+import time
+import zlib
+from typing import Any, Callable, List, Optional
 
 import numpy as np
+
+from ..utils import faults as faults_mod
+from ..utils import log
+
+# module defaults; engine.train() re-configures them from params
+_TIMEOUT = 120.0
+_RETRIES = 2
+_BACKOFF = 0.25     # seconds; doubles per retry
+
+
+class CollectiveError(RuntimeError):
+    """A host-object collective failed after exhausting its retries."""
+
+
+def configure(timeout: Optional[float] = None,
+              retries: Optional[int] = None) -> None:
+    """Set the module-wide timeout/retry budget (collective_timeout /
+    collective_retries params; engine.train wires them per training)."""
+    global _TIMEOUT, _RETRIES
+    if timeout is not None:
+        _TIMEOUT = float(timeout)
+    if retries is not None:
+        _RETRIES = int(retries)
 
 
 def process_count() -> int:
@@ -26,23 +72,154 @@ def process_count() -> int:
     return jax.process_count()
 
 
+def _with_timeout(fn: Callable[[], Any], timeout: float, what: str) -> Any:
+    """Run ``fn`` with a deadline.  The underlying collective cannot be
+    cancelled, but a named timeout beats an indefinite silent hang."""
+    import threading
+    out: List[Any] = []
+    err: List[BaseException] = []
+
+    def run():
+        try:
+            out.append(fn())
+        except BaseException as e:   # re-raised on the caller thread
+            err.append(e)
+
+    t = threading.Thread(target=run, daemon=True, name=f"sync:{what}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise CollectiveError(
+            f"{what} timed out after {timeout:g}s (a peer process is "
+            "stuck or dead; see machine_list_file ordering for ranks)")
+    if err:
+        raise err[0]
+    return out[0]
+
+
+def _retrying(what: str, attempt_fn: Callable[[], Any]) -> Any:
+    """Bounded-retry ladder around one collective attempt; every retry is
+    counted (obs `collective_retries`) and recorded as a structured
+    `collective_retry` event."""
+    from ..obs.counters import counters
+    last: Optional[BaseException] = None
+    for attempt in range(_RETRIES + 1):
+        try:
+            return attempt_fn()
+        except Exception as e:
+            last = e
+            if attempt == _RETRIES:
+                break
+            counters.inc("collective_retries", op=what)
+            counters.event("collective_retry", op=what, attempt=attempt + 1,
+                           error=str(e))
+            log.warning("%s failed (attempt %d/%d): %s — retrying",
+                        what, attempt + 1, _RETRIES + 1, e)
+            time.sleep(_BACKOFF * (2 ** attempt))
+    raise CollectiveError(
+        f"{what} failed after {_RETRIES + 1} attempt(s): {last}") from last
+
+
+def _maybe_inject(what: str) -> None:
+    fi = faults_mod.get_faults()
+    if fi.enabled and fi.fire("collective_fail"):
+        raise faults_mod.InjectedFault(f"collective_fail: injected {what} "
+                                       "failure")
+
+
+def _maybe_corrupt(buf: np.ndarray) -> np.ndarray:
+    fi = faults_mod.get_faults()
+    if fi.enabled and fi.fire("collective_corrupt"):
+        buf = np.array(buf, copy=True)
+        flat = buf.reshape(-1)
+        if flat.size:
+            flat[0] ^= 0xFF      # deterministic single-byte wire corruption
+    return buf
+
+
+def _note(op: str, nbytes: int) -> None:
+    from ..obs.counters import counters
+    counters.inc("collective_calls", op=op, site="parallel/sync")
+    counters.inc("collective_bytes", value=nbytes, op=op,
+                 site="parallel/sync")
+
+
 def allgather_object(obj: Any) -> List[Any]:
     """Gather one picklable host object from every process, in process-index
-    order (Network::Allgather of serialized blobs)."""
-    import jax
-    from jax.experimental import multihost_utils
-    if process_count() == 1:
-        return [obj]
-    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    lens = np.asarray(multihost_utils.process_allgather(
-        np.asarray([len(payload)], np.int64))).reshape(-1)
-    buf = np.zeros(int(lens.max()), np.uint8)
-    buf[:len(payload)] = payload
-    gathered = np.asarray(multihost_utils.process_allgather(buf))
-    return [pickle.loads(gathered[i, :int(lens[i])].tobytes())
-            for i in range(len(lens))]
+    order (Network::Allgather of serialized blobs) — with length+CRC
+    payload verification, per-attempt timeout, and bounded retry."""
+
+    def attempt() -> List[Any]:
+        _maybe_inject("allgather_object")
+        if process_count() == 1:
+            return [obj]
+        from jax.experimental import multihost_utils
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        header = np.asarray([len(payload), zlib.crc32(payload)], np.int64)
+
+        def gather() -> List[Any]:
+            headers = np.asarray(multihost_utils.process_allgather(
+                header)).reshape(-1, 2)
+            lens = headers[:, 0]
+            buf = np.zeros(int(lens.max()), np.uint8)
+            buf[:len(payload)] = payload
+            gathered = _maybe_corrupt(np.asarray(
+                multihost_utils.process_allgather(buf)))
+            out = []
+            for i in range(len(lens)):
+                blob = gathered[i, :int(lens[i])]
+                crc = zlib.crc32(np.ascontiguousarray(blob))
+                if crc != int(headers[i, 1]):
+                    raise CollectiveError(
+                        f"allgather_object payload from process {i} failed "
+                        f"its CRC check (sent {int(headers[i, 1]):08x}, "
+                        f"received {crc:08x}) — corrupt or torn transfer")
+                out.append(pickle.loads(blob.tobytes()))
+            return out
+
+        return _with_timeout(gather, _TIMEOUT, "allgather_object")
+
+    result = _retrying("allgather_object", attempt)
+    if len(result) > 1:
+        _note("allgather_object", sum(len(pickle.dumps(o)) for o in [obj]))
+    return result
 
 
-def broadcast_object(obj: Any) -> Any:
-    """Every process receives process 0's object (rank-0 decision sync)."""
-    return allgather_object(obj)[0]
+def broadcast_object(obj: Any = None) -> Any:
+    """Every process receives process 0's object (rank-0 decision sync).
+
+    A real rank-0 length-then-payload broadcast: non-root processes ship
+    nothing — they only learn the payload size from the header phase and
+    receive the bytes (plus CRC check) in the second."""
+
+    def attempt() -> Any:
+        _maybe_inject("broadcast_object")
+        if process_count() == 1:
+            return obj
+        import jax
+        from jax.experimental import multihost_utils
+        is_root = jax.process_index() == 0
+        payload = (np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+                   if is_root else np.zeros(0, np.uint8))
+        header = np.asarray(
+            [len(payload), zlib.crc32(payload) if is_root else 0], np.int64)
+
+        def bcast() -> Any:
+            hdr = np.asarray(multihost_utils.broadcast_one_to_all(header))
+            n, want = int(hdr[0]), int(hdr[1])
+            buf = payload if is_root else np.zeros(n, np.uint8)
+            got = _maybe_corrupt(np.asarray(
+                multihost_utils.broadcast_one_to_all(buf)))
+            crc = zlib.crc32(np.ascontiguousarray(got[:n]))
+            if crc != want:
+                raise CollectiveError(
+                    f"broadcast_object payload from process 0 failed its "
+                    f"CRC check (sent {want:08x}, received {crc:08x}) on "
+                    f"process {jax.process_index()}")
+            return pickle.loads(got[:n].tobytes())
+
+        out = _with_timeout(bcast, _TIMEOUT, "broadcast_object")
+        _note("broadcast_object", int(header[0]))
+        return out
+
+    return _retrying("broadcast_object", attempt)

@@ -1,0 +1,169 @@
+"""Deterministic fault-injection registry.
+
+Production hardening is only trustworthy if every recovery path can be
+exercised on demand, on CPU, in the fast test tier.  This module is the
+single switchboard: a comma-separated spec names *injection points* wired
+into the snapshot writer (:mod:`lightgbm_tpu.checkpoint`), the objective
+gradient/hessian fetch (:mod:`lightgbm_tpu.boosting`), the host-object
+collectives (:mod:`lightgbm_tpu.parallel.sync`), and histogram dispatch
+(:mod:`lightgbm_tpu.ops.histogram`).
+
+Spec grammar (``fault_inject`` param / ``LGBM_TPU_FAULT_INJECT`` env)::
+
+    fault_inject=nan_grad@3,torn_checkpoint@4,collective_fail_once
+
+* ``point@k``    — fire when the point is hit at iteration ``k`` (one-shot:
+  a rolled-back iteration is re-entered at the same index and must not
+  re-poison itself);
+* ``point_once`` — fire on the first hit, regardless of iteration;
+* ``point``      — fire on every hit.
+
+Known points (unknown names are rejected at parse time so a typo'd spec
+fails fast instead of silently injecting nothing):
+
+===================  ========================================================
+``torn_checkpoint``  snapshot writer leaves a torn (half-written) file at
+                     the final path and raises :class:`SimulatedCrash`
+``nan_grad``         first gradient element becomes NaN for the iteration
+``inf_hess``         first hessian element becomes +inf for the iteration
+``collective_fail``  host-object collective attempt raises
+                     :class:`InjectedFault` (retry ladder visible)
+``collective_corrupt``  received collective payload is bit-flipped so the
+                     CRC integrity check must catch it
+``hist_fail``        histogram dispatch raises :class:`InjectedFault`
+===================  ========================================================
+
+Mirrors the :mod:`lightgbm_tpu.obs.trace` singleton discipline: when no
+spec is installed the active plan is the shared :data:`NULL_FAULTS` whose
+``fire()`` is a constant ``False`` — the hot-loop cost of an armed
+injection point is one attribute read.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+KNOWN_POINTS = ("torn_checkpoint", "nan_grad", "inf_hess", "collective_fail",
+                "collective_corrupt", "hist_fail")
+
+
+class InjectedFault(RuntimeError):
+    """An error deliberately raised by an armed injection point."""
+
+
+class SimulatedCrash(RuntimeError):
+    """Stands in for SIGKILL in tests: training dies mid-snapshot-write."""
+
+
+class _Entry:
+    __slots__ = ("point", "iteration", "once", "fired")
+
+    def __init__(self, point: str, iteration: Optional[int], once: bool):
+        self.point = point
+        self.iteration = iteration
+        self.once = once
+        self.fired = 0
+
+
+def parse_spec(spec: str) -> List[_Entry]:
+    """Parse a fault spec; raises ``ValueError`` on unknown points."""
+    entries: List[_Entry] = []
+    for raw in str(spec or "").split(","):
+        tok = raw.strip()
+        if not tok:
+            continue
+        iteration: Optional[int] = None
+        if "@" in tok:
+            tok, it = tok.split("@", 1)
+            try:
+                iteration = int(it)
+            except ValueError:
+                raise ValueError(f"fault_inject: bad iteration in {raw!r}")
+        once = iteration is not None
+        if tok.endswith("_once"):
+            tok = tok[:-len("_once")]
+            once = True
+        if tok not in KNOWN_POINTS:
+            raise ValueError(f"fault_inject: unknown point {tok!r} "
+                             f"(known: {', '.join(KNOWN_POINTS)})")
+        entries.append(_Entry(tok, iteration, once))
+    return entries
+
+
+class FaultPlan:
+    """An armed set of injection points."""
+    enabled = True
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self._entries = parse_spec(spec)
+        self._lock = threading.Lock()
+
+    def fire(self, point: str, iteration: Optional[int] = None) -> bool:
+        """Should ``point`` trigger now?  One call = one hit (one-shot
+        entries burn on the hit that matches them)."""
+        hit = False
+        with self._lock:
+            for e in self._entries:
+                if e.point != point:
+                    continue
+                if e.iteration is not None and e.iteration != iteration:
+                    continue
+                if e.once and e.fired:
+                    continue
+                e.fired += 1
+                hit = True
+        return hit
+
+    def fired(self, point: str) -> int:
+        with self._lock:
+            return sum(e.fired for e in self._entries if e.point == point)
+
+
+class NullFaults:
+    """Disabled plan — the shared default; ``fire`` never triggers."""
+    enabled = False
+    spec = ""
+
+    def fire(self, point: str, iteration: Optional[int] = None) -> bool:
+        return False
+
+    def fired(self, point: str) -> int:
+        return 0
+
+
+NULL_FAULTS = NullFaults()
+
+_active = NULL_FAULTS
+
+
+def get_faults():
+    """The process-wide active fault plan (NullFaults when disarmed)."""
+    return _active
+
+
+def install(spec: str) -> FaultPlan:
+    """Arm a spec as the process-wide plan; returns it (pass the previous
+    value of :func:`get_faults` to :func:`restore` to scope the arming)."""
+    global _active
+    _active = FaultPlan(spec) if str(spec or "").strip() else NULL_FAULTS
+    return _active
+
+
+def restore(plan) -> None:
+    """Re-install a previously active plan (engine-scoped arming)."""
+    global _active
+    _active = plan
+
+
+def clear() -> None:
+    global _active
+    _active = NULL_FAULTS
+
+
+# env-armed at import: lets the CLI / bench / fault_matrix arm injections
+# without touching params (mirrors JAX_* env conventions)
+_env_spec = os.environ.get("LGBM_TPU_FAULT_INJECT", "")
+if _env_spec.strip():
+    install(_env_spec)

@@ -1,0 +1,155 @@
+"""Run the fault-injection matrix (each fault x each recovery policy) as a
+one-command smoke: every cell trains a tiny deterministic model on CPU with
+one injected fault and asserts the *expected* outcome — completion with a
+structured recovery event, a clean error naming the failure, or (for the
+torn-checkpoint cell) a crash followed by a byte-identical resume.
+
+    python scripts/fault_matrix.py            # full matrix
+    python scripts/fault_matrix.py --fast     # tier-1 subset (the same
+                                              # cells tests/test_robustness.py
+                                              # runs via run_matrix(fast=True))
+
+Exit status is non-zero if any cell deviates, printing the PASS/FAIL table
+either way.  See docs/ROBUSTNESS.md for the fault point and policy
+vocabulary.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+POLICIES = ("raise", "rollback", "clamp")
+FAULTS = ("none", "nan_grad@2", "inf_hess@2", "hist_fail_once",
+          "torn_checkpoint@4", "collective_fail_once")
+# the ~2-minute tier loop runs this subset (tests/test_robustness.py)
+FAST_CELLS = {("none", "raise"), ("nan_grad@2", "raise"),
+              ("nan_grad@2", "rollback"), ("torn_checkpoint@4", "raise"),
+              ("collective_fail_once", "raise")}
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 8)
+    w = rng.randn(8)
+    y = (X @ w + 0.3 * rng.randn(400) > 0).astype(np.float64)
+    return X, y
+
+
+def _run_cell(fault: str, policy: str, X, y, workdir: str) -> str:
+    """Run one cell; returns "ok" or a failure description."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.counters import counters
+    from lightgbm_tpu.parallel import sync
+    from lightgbm_tpu.utils import faults
+    from lightgbm_tpu.utils.faults import InjectedFault, SimulatedCrash
+
+    out = os.path.join(workdir, f"{fault}_{policy}".replace("@", "_"),
+                       "m.txt")
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "nonfinite_policy": policy, "telemetry": True,
+              "snapshot_freq": 2, "output_model": out}
+
+    def train(extra=None, resume=False):
+        p = dict(params, **(extra or {}))
+        return lgb.train(p, lgb.Dataset(X, label=y, free_raw_data=False),
+                         num_boost_round=6, verbose_eval=False,
+                         resume=resume or None)
+
+    try:
+        if fault == "none":
+            bst = train()
+            if counters.events("nonfinite"):
+                return "unexpected nonfinite event on clean run"
+            if not np.isfinite(bst.predict(X, raw_score=True)).all():
+                return "non-finite prediction on clean run"
+            return "ok"
+
+        if fault in ("nan_grad@2", "inf_hess@2"):
+            try:
+                bst = train({"fault_inject": fault})
+            except lgb.NonFiniteError as e:
+                if policy != "raise":
+                    return f"policy={policy} raised: {e}"
+                return "ok" if "iteration 2" in str(e) \
+                    else f"error does not name the iteration: {e}"
+            if policy == "raise":
+                return "raise policy completed silently"
+            evs = counters.events("nonfinite")
+            if len(evs) != 1:
+                return f"expected exactly 1 nonfinite event, got {len(evs)}"
+            if not np.isfinite(bst.predict(X, raw_score=True)).all():
+                return "recovered model is non-finite"
+            return "ok"
+
+        if fault == "hist_fail_once":
+            try:
+                train({"fault_inject": fault})
+                return "hist_fail did not surface"
+            except InjectedFault:
+                return "ok"
+
+        if fault == "torn_checkpoint@4":
+            ref = train().inner.save_model_to_string(-1)
+            out2 = os.path.join(os.path.dirname(out), "crash", "m.txt")
+            try:
+                train({"fault_inject": fault, "output_model": out2})
+                return "torn_checkpoint did not crash"
+            except SimulatedCrash:
+                pass
+            bst = train({"output_model": out2}, resume=True)
+            return "ok" if bst.inner.save_model_to_string(-1) == ref \
+                else "resumed model differs from uninterrupted run"
+
+        if fault == "collective_fail_once":
+            faults.install("collective_fail_once")
+            try:
+                got = sync.allgather_object({"probe": policy})
+                if got != [{"probe": policy}]:
+                    return f"allgather returned {got!r}"
+                retries = counters.get("collective_retries")
+                return "ok" if retries else "retry was not counted"
+            finally:
+                faults.clear()
+
+        return f"unknown fault {fault!r}"
+    except Exception as e:   # noqa: BLE001 - the matrix reports, not raises
+        return f"unexpected {type(e).__name__}: {e}"
+
+
+def run_matrix(fast: bool = False):
+    """Returns (results, failures): results is {(fault, policy): msg}."""
+    X, y = _data()
+    results, failures = {}, []
+    with tempfile.TemporaryDirectory() as workdir:
+        for fault in FAULTS:
+            for policy in POLICIES:
+                if fast and (fault, policy) not in FAST_CELLS:
+                    continue
+                msg = _run_cell(fault, policy, X, y, workdir)
+                results[(fault, policy)] = msg
+                if msg != "ok":
+                    failures.append((fault, policy, msg))
+    return results, failures
+
+
+def main(argv) -> int:
+    fast = "--fast" in argv
+    results, failures = run_matrix(fast=fast)
+    wf = max(len(f) for f, _ in results)
+    print(f"{'fault':<{wf}}  {'policy':<9} result")
+    for (fault, policy), msg in sorted(results.items()):
+        status = "PASS" if msg == "ok" else f"FAIL: {msg}"
+        print(f"{fault:<{wf}}  {policy:<9} {status}")
+    print(f"\n{len(results) - len(failures)}/{len(results)} cells passed"
+          + (" (fast subset)" if fast else ""))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -28,7 +28,9 @@ def main():
 
     cfg_src = os.path.join(os.path.dirname(C.__file__), "config.py")
     lines = open(cfg_src).readlines()
-    field_re = re.compile(r'\s*(\w+):\s*[\w\[\]\., "\'=]+?(?:#\s*(.+))?$')
+    # value class includes '-' so negative defaults (snapshot_freq = -1)
+    # keep their inline descriptions
+    field_re = re.compile(r'\s*(\w+):\s*[\w\[\]\.,\- "\'=]+?(?:#\s*(.+))?$')
     comment_re = re.compile(r"\s*#\s*(.+)$")
     comments = {}
     i = 0

@@ -1,0 +1,407 @@
+"""Fault-tolerance ladder: atomic resumable checkpoints, non-finite
+guards, collective hardening, and the deterministic fault-injection
+harness (docs/ROBUSTNESS.md).
+
+Everything here runs on CPU in the fast tier — that is the point of the
+injection registry: every recovery path is exercised deterministically,
+no chip or real crash required.
+"""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import checkpoint as ckpt
+from lightgbm_tpu.obs.counters import counters
+from lightgbm_tpu.parallel import sync
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.faults import InjectedFault, SimulatedCrash
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No test may leak an armed fault plan into the next."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def small_binary():
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 10)
+    w = rng.randn(10)
+    y = (X @ w + 0.3 * rng.randn(600) > 0).astype(np.float64)
+    return X, y
+
+
+def _datasets(X, y):
+    train = lgb.Dataset(X, label=y, free_raw_data=False)
+    valid = train.create_valid(X[:200], label=y[:200])
+    return train, valid
+
+
+def _params(out=None, **kw):
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    if out is not None:
+        p.update({"bagging_fraction": 0.4, "bagging_freq": 2,
+                  "feature_fraction": 0.8, "snapshot_freq": 2,
+                  "output_model": str(out)})
+    p.update(kw)
+    return p
+
+
+# ------------------------------------------------------------ fault registry
+
+def test_fault_spec_parsing():
+    plan = faults.FaultPlan("nan_grad@3,collective_fail_once,hist_fail")
+    assert not plan.fire("nan_grad", 2)
+    assert plan.fire("nan_grad", 3)
+    assert not plan.fire("nan_grad", 3)     # @k entries are one-shot
+    assert plan.fire("collective_fail")
+    assert not plan.fire("collective_fail")  # _once burned
+    assert plan.fire("hist_fail") and plan.fire("hist_fail")  # bare: always
+    with pytest.raises(ValueError):
+        faults.parse_spec("no_such_point")
+    with pytest.raises(ValueError):
+        faults.parse_spec("nan_grad@x")
+    # config validation rejects bad specs at parse time
+    with pytest.raises(Exception):
+        lgb.train({"objective": "binary", "fault_inject": "bogus_point",
+                   "verbose": -1}, lgb.Dataset(np.zeros((10, 2)),
+                                               label=np.zeros(10)))
+
+
+def test_null_faults_are_disarmed():
+    assert faults.get_faults() is faults.NULL_FAULTS
+    assert not faults.get_faults().fire("nan_grad", 0)
+
+
+# -------------------------------------------------------- checkpoint format
+
+def test_checkpoint_roundtrip_and_integrity(tmp_path):
+    state = {"version": 1, "iteration": 4, "blob": np.arange(7)}
+    data = ckpt.encode("tree\nnum_class=1\n", state)
+    model_str, got = ckpt.decode(data)
+    assert model_str.startswith("tree")
+    assert got["iteration"] == 4
+    np.testing.assert_array_equal(got["blob"], np.arange(7))
+    # torn tail: any truncation must be detected, never half-loaded
+    for cut in (1, len(data) // 2, len(data) - 2):
+        with pytest.raises(ckpt.CheckpointError):
+            ckpt.decode(data[:cut])
+    # bit corruption in the middle fails the CRC
+    corrupt = bytearray(data)
+    corrupt[len(data) // 3] ^= 0xFF
+    with pytest.raises(ckpt.CheckpointError):
+        ckpt.decode(bytes(corrupt))
+
+
+def test_find_latest_valid_skips_torn_tail(tmp_path):
+    out = str(tmp_path / "m.txt")
+    good = ckpt.encode("tree\n", {"version": 1, "iteration": 2})
+    with open(ckpt.snapshot_path(out, 2), "wb") as f:
+        f.write(good)
+    torn = ckpt.encode("tree\n", {"version": 1, "iteration": 4})
+    with open(ckpt.snapshot_path(out, 4), "wb") as f:
+        f.write(torn[:len(torn) // 2])
+    it, path, state = ckpt.find_latest_valid(out)
+    assert it == 2 and state["iteration"] == 2
+    assert ckpt.find_latest_valid(str(tmp_path / "nothing")) is None
+
+
+# --------------------------------------------------------- crash and resume
+
+def test_crash_resume_byte_identical(tmp_path, small_binary):
+    """THE resumability contract: kill training mid-snapshot-write (torn
+    file at iteration 6), auto-resume from the latest valid snapshot
+    (iteration 4 — the torn 6 must be skipped), and the final model is
+    byte-identical to an uninterrupted run, eval history included."""
+    X, y = small_binary
+    es = dict(early_stopping_rounds=50)   # exercises ES state checkpointing
+
+    out_a = str(tmp_path / "a" / "m.txt")
+    tr, va = _datasets(X, y)
+    ev_a = {}
+    bst_a = lgb.train(_params(out_a), tr, num_boost_round=8, valid_sets=[va],
+                      evals_result=ev_a, verbose_eval=False, **es)
+    ref = bst_a.inner.save_model_to_string(-1)
+
+    out_b = str(tmp_path / "b" / "m.txt")
+    tr, va = _datasets(X, y)
+    with pytest.raises(SimulatedCrash):
+        lgb.train(_params(out_b, fault_inject="torn_checkpoint@6"), tr,
+                  num_boost_round=8, valid_sets=[va], evals_result={},
+                  verbose_eval=False, **es)
+    snaps = [p for p in os.listdir(tmp_path / "b") if "snapshot" in p]
+    assert "m.txt.snapshot_iter_6" in snaps    # the torn file exists...
+
+    tr, va = _datasets(X, y)
+    ev_c = {}
+    bst_c = lgb.train(_params(out_b), tr, num_boost_round=8, valid_sets=[va],
+                      evals_result=ev_c, verbose_eval=False, resume=True,
+                      **es)
+    assert bst_c.inner.save_model_to_string(-1) == ref   # ...and is skipped
+    assert ev_c == ev_a
+    assert bst_c.best_iteration == bst_a.best_iteration
+
+
+def test_resume_from_explicit_path_and_fresh_start(tmp_path, small_binary):
+    X, y = small_binary
+    out = str(tmp_path / "m.txt")
+    tr, va = _datasets(X, y)
+    bst = lgb.train(_params(out), tr, num_boost_round=6, valid_sets=[va],
+                    verbose_eval=False)
+    ref = bst.inner.save_model_to_string(-1)
+
+    # explicit checkpoint path resumes from exactly that snapshot
+    tr, va = _datasets(X, y)
+    bst2 = lgb.train(_params(out), tr, num_boost_round=6, valid_sets=[va],
+                     verbose_eval=False, resume=ckpt.snapshot_path(out, 4))
+    assert bst2.inner.save_model_to_string(-1) == ref
+
+    # resume=True with no snapshots trains from scratch, same result
+    out2 = str(tmp_path / "fresh" / "m.txt")
+    tr, va = _datasets(X, y)
+    bst3 = lgb.train(_params(out2), tr, num_boost_round=6, valid_sets=[va],
+                     verbose_eval=False, resume=True)
+    assert bst3.inner.save_model_to_string(-1) == ref
+
+
+def test_snapshot_is_still_a_valid_model_file(tmp_path, small_binary):
+    """The checkpoint payload rides BEHIND the ordinary model text, so
+    ``Booster(model_file=<snapshot>)`` keeps working on snapshots."""
+    X, y = small_binary
+    out = str(tmp_path / "m.txt")
+    tr, _ = _datasets(X, y)
+    lgb.train(_params(out), tr, num_boost_round=4, verbose_eval=False)
+    snap = ckpt.snapshot_path(out, 4)
+    loaded = lgb.Booster(model_file=snap)
+    preds = loaded.predict(X[:16])
+    assert np.isfinite(preds).all()
+
+
+def test_snapshot_keep_prunes_retention(tmp_path, small_binary):
+    X, y = small_binary
+    out = str(tmp_path / "m.txt")
+    tr, _ = _datasets(X, y)
+    lgb.train(_params(out, snapshot_keep=2), tr, num_boost_round=8,
+              verbose_eval=False)
+    its = [it for it, _ in ckpt.list_snapshots(out)]
+    assert its == [6, 8]
+
+
+# --------------------------------------------------------- non-finite guard
+
+def test_nan_grad_raise_names_iteration(small_binary):
+    """Default policy (pipelined path): injected NaN gradients fail the
+    training with an error naming the poisoned iteration."""
+    X, y = small_binary
+    with pytest.raises(lgb.NonFiniteError, match="iteration 3"):
+        lgb.train(_params(fault_inject="nan_grad@3"),
+                  lgb.Dataset(X, label=y), num_boost_round=6,
+                  verbose_eval=False)
+
+
+def test_nan_grad_raise_synchronous_path(small_binary):
+    X, y = small_binary
+    with pytest.raises(lgb.NonFiniteError, match="iteration 2"):
+        lgb.train(_params(fault_inject="nan_grad@2", pipeline_trees=False),
+                  lgb.Dataset(X, label=y), num_boost_round=6,
+                  verbose_eval=False)
+
+
+def test_nan_grad_rollback_one_event_finite_model(small_binary):
+    """Acceptance: nan_grad@k under rollback completes with exactly ONE
+    structured nonfinite event and a finite final model."""
+    X, y = small_binary
+    bst = lgb.train(_params(fault_inject="nan_grad@3",
+                            nonfinite_policy="rollback", telemetry=True),
+                    lgb.Dataset(X, label=y), num_boost_round=6,
+                    verbose_eval=False)
+    evs = counters.events("nonfinite")
+    assert len(evs) == 1
+    assert evs[0]["iteration"] == 3 and evs[0]["policy"] == "rollback"
+    assert counters.total("nonfinite_trips") == 1
+    preds = bst.predict(X, raw_score=True)
+    assert np.isfinite(preds).all()
+
+
+def test_inf_hess_rollback(small_binary):
+    X, y = small_binary
+    bst = lgb.train(_params(fault_inject="inf_hess@1",
+                            nonfinite_policy="rollback", telemetry=True),
+                    lgb.Dataset(X, label=y), num_boost_round=4,
+                    verbose_eval=False)
+    assert len(counters.events("nonfinite")) == 1
+    assert np.isfinite(bst.predict(X, raw_score=True)).all()
+
+
+def test_nonfinite_clamp_completes_with_event(small_binary):
+    X, y = small_binary
+    bst = lgb.train(_params(fault_inject="nan_grad@2",
+                            nonfinite_policy="clamp", telemetry=True),
+                    lgb.Dataset(X, label=y), num_boost_round=6,
+                    verbose_eval=False)
+    evs = counters.events("nonfinite")
+    assert len(evs) == 1 and evs[0]["policy"] == "clamp"
+    assert np.isfinite(bst.predict(X, raw_score=True)).all()
+
+
+def test_clean_run_has_no_nonfinite_events(small_binary):
+    X, y = small_binary
+    lgb.train(_params(telemetry=True), lgb.Dataset(X, label=y),
+              num_boost_round=4, verbose_eval=False)
+    assert counters.events("nonfinite") == []
+    assert counters.total("nonfinite_trips") == 0
+
+
+def test_nonfinite_policy_validated():
+    with pytest.raises(Exception):
+        lgb.train({"objective": "binary", "nonfinite_policy": "ignore",
+                   "verbose": -1},
+                  lgb.Dataset(np.zeros((10, 2)), label=np.zeros(10)))
+
+
+# ----------------------------------------------------- histogram fault point
+
+def test_hist_fail_injection_surfaces(small_binary):
+    X, y = small_binary
+    with pytest.raises(InjectedFault, match="hist_fail"):
+        lgb.train(_params(fault_inject="hist_fail_once"),
+                  lgb.Dataset(X, label=y), num_boost_round=2,
+                  verbose_eval=False)
+
+
+# -------------------------------------------------------- collective ladder
+
+def test_collective_retry_recovers_and_counts():
+    counters.reset()
+    faults.install("collective_fail_once")
+    assert sync.allgather_object({"a": 1}) == [{"a": 1}]
+    assert counters.get("collective_retries") == \
+        {"op=allgather_object": 1}
+    assert counters.events("collective_retry")[0]["op"] == "allgather_object"
+
+
+def test_collective_persistent_failure_surfaces():
+    faults.install("collective_fail")
+    with pytest.raises(sync.CollectiveError, match="after 3 attempt"):
+        sync.allgather_object(1)
+
+
+def test_broadcast_object_single_process():
+    obj = {"x": [1, 2, 3]}
+    assert sync.broadcast_object(obj) == obj
+    faults.install("collective_fail_once")
+    assert sync.broadcast_object(obj) == obj   # retried
+
+
+def test_collective_budget_configurable():
+    sync.configure(timeout=5.0, retries=0)
+    try:
+        faults.install("collective_fail")
+        with pytest.raises(sync.CollectiveError, match="after 1 attempt"):
+            sync.allgather_object(1)
+    finally:
+        sync.configure(timeout=120.0, retries=2)
+
+
+# ------------------------------------------------- satellite: rollback exact
+
+def test_rollback_one_iter_multiclass_bit_exact():
+    """rollback_one_iter must restore train AND valid scores bit-exactly
+    in the multiclass case — the invariant nonfinite_policy=rollback's
+    same-iteration unwind depends on."""
+    rng = np.random.RandomState(3)
+    n, k = 900, 3
+    centers = rng.randn(k, 6) * 3
+    labels = rng.randint(0, k, n)
+    X = centers[labels] + rng.randn(n, 6)
+    y = labels.astype(np.float64)
+    train = lgb.Dataset(X, label=y, free_raw_data=False)
+    valid = train.create_valid(X[:300], label=y[:300])
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "verbose": -1, "num_leaves": 7,
+                     "pipeline_trees": False},
+                    train, num_boost_round=3, valid_sets=[valid],
+                    verbose_eval=False)
+    inner = bst.inner
+    s0 = np.asarray(inner.scores).copy()
+    v0 = [np.asarray(vs.scores).copy() for vs in inner.valid_sets]
+    n_models, it0 = len(inner.models), inner.iter_
+    bst.update()
+    assert len(inner.models) == n_models + 3
+    bst.rollback_one_iter()
+    assert len(inner.models) == n_models and inner.iter_ == it0
+    np.testing.assert_array_equal(np.asarray(inner.scores), s0)
+    for vs, v in zip(inner.valid_sets, v0):
+        np.testing.assert_array_equal(np.asarray(vs.scores), v)
+
+
+# --------------------------------------------- satellite: early-stop vs NaN
+
+def test_early_stopping_all_nan_metric(small_binary):
+    """A metric that always evaluates to NaN never counts as an
+    improvement: training early-stops once the patience runs out and the
+    best iteration stays at the initial one."""
+    X, y = small_binary
+    tr, va = _datasets(X, y)
+
+    def nan_metric(preds, dataset):
+        return ("nanmetric", float("nan"), True)
+
+    bst = lgb.train(_params(metric="None"), tr, num_boost_round=20,
+                    valid_sets=[va], feval=nan_metric,
+                    early_stopping_rounds=3, verbose_eval=False)
+    assert bst.best_iteration == 1
+    assert bst.current_iteration() < 20
+
+
+def test_early_stopping_nan_after_improvement(small_binary):
+    """NaN appearing mid-stream freezes the best at the last finite
+    improvement instead of replacing it."""
+    X, y = small_binary
+    tr, va = _datasets(X, y)
+    values = iter([0.9, 0.7, float("nan"), float("nan"), float("nan"),
+                   float("nan"), float("nan")])
+
+    def decaying_then_nan(preds, dataset):
+        return ("m", next(values), False)    # lower is better
+
+    bst = lgb.train(_params(metric="None"), tr, num_boost_round=7,
+                    valid_sets=[va], feval=decaying_then_nan,
+                    early_stopping_rounds=3, verbose_eval=False)
+    assert bst.best_iteration == 2           # the 0.7 at iteration index 1
+    assert not any(math.isnan(v)
+                   for v in bst.best_score.get("valid_0", {}).values())
+
+
+def test_dart_resume_byte_identical(tmp_path, small_binary):
+    """DART's extra state (drop RNG stream, tree weights, normalization
+    sum) rides the checkpoint too — resume mid-run must reproduce the
+    uninterrupted model exactly."""
+    X, y = small_binary
+    out = str(tmp_path / "m.txt")
+    p = _params(out, boosting="dart", drop_rate=0.5)
+    tr, _ = _datasets(X, y)
+    ref = lgb.train(p, tr, num_boost_round=6,
+                    verbose_eval=False).inner.save_model_to_string(-1)
+    tr, _ = _datasets(X, y)
+    bst = lgb.train(p, tr, num_boost_round=6, verbose_eval=False,
+                    resume=ckpt.snapshot_path(out, 4))
+    assert bst.inner.save_model_to_string(-1) == ref
+
+
+# -------------------------------------------------- satellite: fault matrix
+
+def test_fault_matrix_fast_subset():
+    """The tier-1 slice of scripts/fault_matrix.py (the full matrix is the
+    one-command smoke; this keeps its fast cells honest in every run)."""
+    import importlib
+    fm = importlib.import_module("scripts.fault_matrix")
+    results, failures = fm.run_matrix(fast=True)
+    assert results, "fast subset selected no cells"
+    assert not failures, failures
