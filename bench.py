@@ -42,7 +42,12 @@ BENCH_FUSED=0 drops the fused rung — the capture playbook's forced-gen-1
 A/B (bench_1m_gen1.json) against the default ladder's headline.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
-"telemetry"[, "degraded", "kernel_mismatch"]}.  The "telemetry" block
+"telemetry"[, "leaves_sweep", "degraded", "kernel_mismatch"]}.
+"leaves_sweep" (cpu rung by default; BENCH_LEAVES_SWEEP=1 to force on
+tpu, =0 to disable) is the deep-tree fixed-cost micro-rung: marginal ms
+per additional leaf between 31- and 255-leaf trees at <= 200k rows —
+the per-split fixed overhead the round-7 work collapsed, tracked per
+round.  The "telemetry" block
 carries the OBSERVED histogram-kernel identity (lightgbm_tpu.obs dispatch
 counters) — if it disagrees with the rung label the result is marked
 degraded + kernel_mismatch so decide_flips.py refuses to compare it.
@@ -176,6 +181,50 @@ def _construct_cached(make_xy, cfg, n_rows, n_feat, sparsity, params):
     return ds
 
 
+def _leaves_sweep(params, n_rows, n_feat, sparsity):
+    """Deep-tree fixed-cost micro-rung: per-tree time at 31 vs 255 leaves
+    on <= 200k rows (CPU-safe), reported as marginal ms per additional
+    leaf at fixed N.  This is the quantity the round-7 perf work
+    collapsed (carried-state copies + kilobucket padding made it ~70% of
+    deep-tree time); embedding it in every BENCH JSON lets the trajectory
+    track deep-tree overhead per round.  Runs by default on the cpu rung,
+    BENCH_LEAVES_SWEEP=1 forces it on tpu rungs (two extra grower
+    compiles), =0 disables."""
+    import time
+
+    import jax
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import config_from_params
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.obs.counters import counters as obs_counters
+
+    rows = min(n_rows, 200_000)
+    lo, hi = 31, 255
+    n_timed = int(os.environ.get("BENCH_LEAVES_SWEEP_TREES", 2))
+    sec = {}
+    ds = None
+    for leaves in (lo, hi):
+        p = dict(params, num_leaves=leaves)
+        cfg = config_from_params(p)
+        if ds is None:      # num_leaves never keys dataset construction
+            ds = _construct_cached(
+                lambda: make_data(rows, n_feat, sparsity), cfg, rows,
+                n_feat, sparsity, p)
+        booster = create_boosting(cfg, ds, create_objective(cfg))
+        booster.train_one_iter()              # warmup (compile)
+        jax.block_until_ready(booster.scores)
+        t0 = time.perf_counter()
+        for _ in range(n_timed):
+            booster.train_one_iter()
+        jax.block_until_ready(booster.scores)
+        sec[leaves] = (time.perf_counter() - t0) / n_timed
+    marginal = (sec[hi] - sec[lo]) / (hi - lo) * 1e3
+    obs_counters.gauge("leaves_sweep_marginal_ms_per_leaf", marginal)
+    return {"rows": rows, "leaves": [lo, hi],
+            "sec_per_tree": {str(k): round(v, 4) for k, v in sec.items()},
+            "marginal_ms_per_leaf": round(marginal, 3)}
+
+
 def child_main():
     """The measured workload.  Runs under BENCH_CHILD with the platform and
     histogram method fixed by the supervisor; prints the result JSON line."""
@@ -279,9 +328,22 @@ def child_main():
     # grower ACTUALLY traced.  A disagreement with the resolved label (e.g.
     # a fused request silently downgraded inside jit, or a pallas rung
     # degraded to einsum) marks the rung degraded so decide_flips never
-    # compares mislabeled numbers.
-    trace_file = obs_trace.stop() if bench_trace else None
+    # compares mislabeled numbers.  The kernel identity is snapshotted
+    # BEFORE the leaves-sweep micro-rung trains its extra boosters.
     observed = obs_counters.observed_kernel()
+
+    # deep-tree fixed-cost micro-rung (31 vs 255 leaves, <= 200k rows):
+    # default on for the cpu rung, opt-in (BENCH_LEAVES_SWEEP=1) on tpu
+    sweep_flag = os.environ.get("BENCH_LEAVES_SWEEP", "")
+    leaves_sweep = None
+    if sweep_flag != "0" and (platform == "cpu" or sweep_flag == "1"):
+        try:
+            leaves_sweep = _leaves_sweep(params, n_rows, n_feat, sparsity)
+            sys.stderr.write(f"bench: leaves_sweep {json.dumps(leaves_sweep)}\n")
+        except Exception as e:       # the micro-rung never kills the bench
+            leaves_sweep = {"error": str(e)[:200]}
+
+    trace_file = obs_trace.stop() if bench_trace else None
     telemetry = {
         "observed_kernel": observed,
         "hist_dispatch": obs_counters.get("hist_dispatch"),
@@ -316,6 +378,8 @@ def child_main():
         "link": link,
         "telemetry": telemetry,
     }
+    if leaves_sweep is not None:
+        result["leaves_sweep"] = leaves_sweep
     if kernel_mismatch:
         result["kernel_mismatch"] = True
         result["degraded"] = (f"kernel identity mismatch: rung label "

@@ -259,7 +259,11 @@ class Config:
     cpu_hist_method: str = "segment"   # off-TPU histogram: segment | einsum
     pallas_feat_tile: int = 8      # kernel grid: features per block
     pallas_row_tile: int = 512     # kernel grid: rows per block
-    pallas_bucket_min_log2: int = 10   # smallest pow2 gather bucket
+    pallas_bucket_min_log2: int = 6    # smallest pow2 gather bucket (64
+                                       # rows: deep-tree tail splits pay
+                                       # O(leaf) work, not kilobucket
+                                       # padding; sub-512 buckets shrink
+                                       # the Pallas row tile to match)
     gather_words: str = "auto"     # pack bin columns into u32 words for the
                                    # histogram row gather: auto | on | off
     gather_panel: str = "auto"     # fold the f32 weight columns into the

@@ -68,7 +68,10 @@ class GrowerConfig(NamedTuple):
                                      # the layout cannot fuse)
     feat_tile: int = 8               # Pallas grid: features per block
     row_tile: int = 512              # Pallas grid: rows per block
-    bucket_min_log2: int = 10        # smallest pow2 gather-buffer bucket
+    bucket_min_log2: int = 6         # smallest pow2 gather-buffer bucket
+    #                                  (64 rows: tail splits of deep trees
+    #                                  stop paying kilobucket padding —
+    #                                  round-7 leaves-sweep measurement)
     gather_words: str = "auto"       # word-pack bin columns for row gathers
     hist_impl: str = "auto"          # pallas kernel form: onehot | nibble
     ordered_bins: str = "off"        # leaf-ordered bin matrix: on | off
@@ -329,8 +332,16 @@ def _set(arr, idx, value):
     return arr.at[idx].set(value)
 
 
-def _update_splits(splits: SplitResult, idx, res: SplitResult) -> SplitResult:
-    return SplitResult(*[_set(a, idx, v) for a, v in zip(splits, res)])
+def _update_splits(splits: SplitResult, idx, res: SplitResult,
+                   skip=()) -> SplitResult:
+    """Write ``res`` into the per-leaf SoA at ``idx``; fields in ``skip``
+    keep their stored arrays untouched (the grower skips the categorical
+    fields when the dataset has none — the incoming values are all-zero
+    and the stored arrays already are, so the scatters would be per-split
+    no-op work)."""
+    return SplitResult(*[a if name in skip else _set(a, idx, v)
+                         for name, a, v in zip(SplitResult._fields,
+                                               splits, res)])
 
 
 def _depth_gate(res: SplitResult, leaf_depth, max_depth) -> SplitResult:
@@ -349,12 +360,12 @@ def _bucket_sizes(cfg: "GrowerConfig", n: int):
     ``pow2``: {2^k} — avg padding ~1.44x of the leaf count.
     ``pow15``: {2^k, 3*2^(k-1)} — avg padding ~1.21x at 2x the branch
     count (compile cost is one-time via the persistent cache; runtime
-    executes exactly one branch either way).  At the default
-    bucket_min_log2 >= 10 every size is a multiple of 512 (pow2 needs
-    >= 9; pow15's smallest odd bucket is 3 << kmin), so any Pallas
-    row_tile that divides the min bucket divides them all; smaller
-    configured values rely on the kernel padding rows to a row_tile
-    multiple instead."""
+    executes exactly one branch either way).  Buckets below 512 rows use
+    a NARROW Pallas row_tile (the bucket rounded up to the 128-lane
+    floor, ``_bucket_row_tile``) so deep-tree tail splits stop padding
+    their handful of rows to a full 512-row kernel tile; at
+    bucket_min_log2 >= 9 every size is a multiple of 512 and the
+    configured row_tile applies unchanged."""
     kmin = cfg.bucket_min_log2
     kmax = max(int(n - 1).bit_length(), kmin)
     sizes = {1 << k for k in range(kmin, kmax + 1)}
@@ -366,6 +377,15 @@ def _bucket_sizes(cfg: "GrowerConfig", n: int):
     return sizes
 
 
+def _bucket_row_tile(cfg: "GrowerConfig", size: int) -> int:
+    """Pallas row tile for a gather bucket: the configured tile, shrunk
+    to the bucket (rounded up to the 128-lane tiling floor) for the
+    sub-512 tail buckets so a 64-row split costs a 128-row kernel launch
+    instead of a 512-row one.  The dropped padding rows all carry zero
+    weight, so the histogram totals are unchanged."""
+    return min(cfg.row_tile, max(128, -(-size // 128) * 128))
+
+
 def _bucket_index(scnt, sizes):
     """Index of the smallest bucket holding ``scnt`` rows: exact integer
     comparisons against the static size table (a float log2 would
@@ -374,13 +394,20 @@ def _bucket_index(scnt, sizes):
     return jnp.sum((scnt > table).astype(jnp.int32))
 
 
-def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
+def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
+                step_limit: bool = False) -> Callable:
     """Build the jittable ``grow_tree`` function.
 
     ``strategy`` selects the (distributed) learner; default is the
     single-device :class:`SerialStrategy`.  This mirrors the reference's
     ``CreateTreeLearner`` factory (tree_learner.cpp:9-33) with strategies in
     place of subclass overrides.
+
+    ``step_limit=True`` prepends a traced ``max_steps`` i32 scalar to the
+    returned function's signature and caps the split loop at that many
+    steps — the per-step cost profiler (scripts/profile_grow_steps.py)
+    times t(k) - t(k-1) over one compilation to get the step-index→ms
+    curve.  Training never sets it.
 
     ``pack_plan`` (data/packing.py) switches the histogram path to a
     nibble-packed storage matrix, the dense_nbits_bin.hpp analogue: the
@@ -403,7 +430,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                   hw: jnp.ndarray,          # [N] f32   hess * bag_weight
                   cw: jnp.ndarray,          # [N] f32   bag weight (0/1 or frac)
                   meta: FeatureMeta,
-                  feat_valid: jnp.ndarray   # [F] bool
+                  feat_valid: jnp.ndarray,  # [F] bool
+                  max_steps=None            # profiler-only split-loop cap
                   ):
         n, f = bins.shape
         dtype = gw.dtype
@@ -520,14 +548,15 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         tracer = obs_trace.get_tracer()
 
         def find(hist, pg, ph, pc, feat_ok):
-            with tracer.span("split_find"), jax.named_scope("split_find"):
+            with tracer.span("split_find", traced=True), \
+                    jax.named_scope("split_find"):
                 return strategy.find(ctx, hist, pg, ph, pc, feat_ok)
 
-        def hist_subset(rows, g_, h_, c_, site="split"):
+        def hist_subset(rows, g_, h_, c_, site="split", row_tile=None):
             return subset_histogram(rows, g_, h_, c_, hist_width,
                                     method=base_method,
                                     feat_tile=cfg.feat_tile,
-                                    row_tile=cfg.row_tile,
+                                    row_tile=row_tile or cfg.row_tile,
                                     impl=cfg.hist_impl,
                                     interpret=cfg.hist_interpret,
                                     site=site)
@@ -544,7 +573,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 num_row_tiles=nt.astype(jnp.int32),
                 interpret=cfg.hist_interpret, site="split")
 
-        def measure(idx):
+        def measure(idx, row_tile=None):
             """RAW histogram of rows ``idx`` (sentinel-padded): packed
             storage columns stay in joint form so a cross-shard psum
             moves one 256-bin histogram per packed PAIR; ``globalize``
@@ -557,14 +586,15 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 g_, h_, c_ = (lax.bitcast_convert_type(pan[:, n_words + k],
                                                        jnp.float32)
                               for k in range(3))
-                return hist_subset(rows, g_, h_, c_)
+                return hist_subset(rows, g_, h_, c_, row_tile=row_tile)
             if use_words == "on":
                 rows = unpack_gather_words(
                     hwords_pad.at[idx].get(mode="promise_in_bounds"),
                     hbins_pad.shape[1], words_per)
             else:
                 rows = hbins_pad.at[idx].get(mode="promise_in_bounds")
-            return hist_subset(rows, gw_pad[idx], hw_pad[idx], cw_pad[idx])
+            return hist_subset(rows, gw_pad[idx], hw_pad[idx], cw_pad[idx],
+                               row_tile=row_tile)
 
         def globalize(hist):
             """reduce across shards, then unfold packed columns."""
@@ -574,6 +604,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             return hist
 
         def bucket_branch(size):
+            rt = _bucket_row_tile(cfg, size)
+
             def branch(args):
                 order, obins, ow, sstart, scnt = args
                 if use_ordered:
@@ -583,10 +615,11 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                     mask = (jnp.arange(size, dtype=jnp.int32)
                             < scnt).astype(wwt.dtype)
                     return hist_subset(wb, wwt[:, 0] * mask,
-                                       wwt[:, 1] * mask, wwt[:, 2] * mask)
+                                       wwt[:, 1] * mask, wwt[:, 2] * mask,
+                                       row_tile=rt)
                 idx = lax.dynamic_slice(order, (sstart,), (size,))
                 valid = jnp.arange(size, dtype=jnp.int32) < scnt
-                return measure(jnp.where(valid, idx, n))
+                return measure(jnp.where(valid, idx, n), row_tile=rt)
             return branch
 
         # fused rung: no gather buckets are traced at all — the pow2
@@ -719,35 +752,41 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                     else:
                         _, new_win = lax.sort((key, win),
                                               is_stable=True, num_keys=1)
-                else:
-                    c1 = jnp.cumsum(goes_left.astype(jnp.int32))
-                    nl = c1[-1]
-                    # right-side rank needs cumsum(valid & ~goes_left);
-                    # since valid = j < cnt that cumsum is
-                    # min(j+1, cnt) - c1 in closed form — one cumsum pass
-                    # instead of two
-                    c0 = jnp.minimum(j + 1, cnt) - c1
-                    # stable two-way rank inside the window; rows past the
-                    # leaf (and sentinel padding) keep their own slot so
-                    # the write-back leaves neighbors untouched
-                    rank = jnp.where(goes_left, c1 - 1, nl + c0 - 1)
-                    rank = jnp.where(valid, rank, j)
-                    new_win = jnp.zeros((size,), jnp.int32).at[rank].set(
-                        win, unique_indices=True)
-                    if use_ordered:
-                        # permute the ordered data windows, same ranks
-                        if not route_from_obins:
-                            wb = lax.dynamic_slice(
-                                obins, (start, 0), (size, obins.shape[1]))
-                        wwt = lax.dynamic_slice(ow, (start, 0), (size, 3))
-                        new_wb = jnp.zeros_like(wb).at[rank].set(
-                            wb, unique_indices=True)
-                        new_wt = jnp.zeros_like(wwt).at[rank].set(
-                            wwt, unique_indices=True)
-                        obins = lax.dynamic_update_slice(
-                            obins, new_wb, (start, 0))
-                        ow = lax.dynamic_update_slice(ow, new_wt, (start, 0))
-                order = lax.dynamic_update_slice(order, new_win, (start,))
+                    order = lax.dynamic_update_slice(order, new_win, (start,))
+                    return order, obins, ow, nl
+                c1 = jnp.cumsum(goes_left.astype(jnp.int32))
+                nl = c1[-1]
+                # right-side rank needs cumsum(valid & ~goes_left);
+                # since valid = j < cnt that cumsum is
+                # min(j+1, cnt) - c1 in closed form — one cumsum pass
+                # instead of two
+                c0 = jnp.minimum(j + 1, cnt) - c1
+                # stable two-way rank inside the window; rows past the
+                # leaf (and sentinel padding) keep their own slot so
+                # the write-back leaves neighbors untouched
+                rank = jnp.where(goes_left, c1 - 1, nl + c0 - 1)
+                rank = jnp.where(valid, rank, j)
+                # ONE scatter straight into ``order`` at start + rank —
+                # not a window-local scatter followed by a
+                # dynamic_update_slice write-back.  The read-then-write
+                # interference of the DUS form made XLA:CPU's copy
+                # insertion clone the whole O(N) carrier once per split
+                # (tests/test_grow_jaxpr.py pins the jaxpr against this
+                # class of regression); the direct scatter updates it in
+                # place, and touches the same slots with the same values
+                # so trees are bit-identical.
+                order = order.at[start + rank].set(
+                    win, unique_indices=True, mode="promise_in_bounds")
+                if use_ordered:
+                    # permute the ordered data windows, same ranks
+                    if not route_from_obins:
+                        wb = lax.dynamic_slice(
+                            obins, (start, 0), (size, obins.shape[1]))
+                    wwt = lax.dynamic_slice(ow, (start, 0), (size, 3))
+                    obins = obins.at[start + rank].set(
+                        wb, unique_indices=True, mode="promise_in_bounds")
+                    ow = ow.at[start + rank].set(
+                        wwt, unique_indices=True, mode="promise_in_bounds")
                 return order, obins, ow, nl
             return branch
 
@@ -785,7 +824,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
 
         num_logical = meta.num_bin.shape[0]
         feat_ok_all = jnp.ones((num_logical,), bool)
-        with tracer.span("histogram", site="root"), \
+        with tracer.span("histogram", site="root", traced=True), \
                 jax.named_scope("histogram"):
             if use_fused:
                 # the fused rung is SELF-CONTAINED: the root histogram goes
@@ -836,8 +875,11 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         )
 
         def cond(state: _LoopState):
-            return ((state.step < L - 1)
-                    & (jnp.max(state.splits.gain) > 0.0))
+            ok = ((state.step < L - 1)
+                  & (jnp.max(state.splits.gain) > 0.0))
+            if max_steps is not None:
+                ok = ok & (state.step < max_steps)
+            return ok
 
         def body(state: _LoopState) -> _LoopState:
             i = state.step
@@ -856,7 +898,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             start = state.leaf_start[l]
             cnt = state.leaf_cnt[l]
             kp = _bucket_index(cnt, bsizes)
-            with tracer.span("partition"), jax.named_scope("partition"):
+            with tracer.span("partition", traced=True), \
+                    jax.named_scope("partition"):
                 order, obins, ow, nl = lax.switch(
                     kp, pbranches,
                     (state.order, state.obins, state.ow, start, cnt,
@@ -881,6 +924,13 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             parent_h = splits.left_sum_h[l] + splits.right_sum_h[l]
             parent_depth = tree.leaf_depth[l]
             child_depth = parent_depth + 1
+            # without categorical features every categorical field is
+            # statically all-zero — skip their per-split scatters
+            # (cat_bins is the [L, B] one, real per-step work)
+            cat_upd = dict(
+                is_cat=_set(tree.is_cat, node, splits.is_cat[l]),
+                cat_bins=tree.cat_bins.at[node].set(splits.cat_bins[l]),
+            ) if cfg.has_categorical else {}
             tree = tree._replace(
                 num_leaves=new_leaf + 1,
                 split_feature=_set(tree.split_feature, node, feat),
@@ -900,8 +950,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
                 leaf_parent=_set(_set(tree.leaf_parent, l, node), new_leaf, node),
                 leaf_depth=_set(_set(tree.leaf_depth, l, child_depth),
                                 new_leaf, child_depth),
-                is_cat=_set(tree.is_cat, node, splits.is_cat[l]),
-                cat_bins=tree.cat_bins.at[node].set(splits.cat_bins[l]),
+                **cat_upd,
             )
 
             # --- smaller-child histogram + parent subtraction ----------------
@@ -910,7 +959,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             small_left = splits.left_count[l] <= splits.right_count[l]
             sstart = jnp.where(small_left, start, start + nl)
             scnt = jnp.where(small_left, nl, nr)   # LOCAL count of that child
-            with tracer.span("histogram", site="split"), \
+            with tracer.span("histogram", site="split", traced=True), \
                     jax.named_scope("histogram"):
                 if use_fused:
                     # gen-2: the kernel gathers the window rows itself from
@@ -926,10 +975,19 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             hist_large = hist_parent - hist_small
             hist_l = jnp.where(small_left, hist_small, hist_large)
             hist_r = jnp.where(small_left, hist_large, hist_small)
-            hist_store = lax.dynamic_update_index_in_dim(
-                state.hist_store, hist_l, l, axis=0)
-            hist_store = lax.dynamic_update_index_in_dim(
-                hist_store, hist_r, new_leaf, axis=0)
+            # both children land in the store through ONE fused scatter.
+            # The previous two-dynamic_update_slice chain read the carried
+            # store (the parent fetch above) and then updated it twice,
+            # and XLA:CPU's copy insertion resolved that interference by
+            # cloning the WHOLE [L, F, B, 3] pool twice per split — at
+            # 255 leaves x 28 x 256 that was ~44 MB of memcpy per split,
+            # the dominant per-split fixed cost of deep trees (measured
+            # ~5 ms/split; docs/PERF.md round-7 cost model).  The single
+            # pair scatter updates the pool in place.
+            pair = jnp.stack([l, new_leaf])
+            hist_store = state.hist_store.at[pair].set(
+                jnp.stack([hist_l, hist_r]), unique_indices=True,
+                mode="promise_in_bounds")
 
             # children scan only the features the PARENT found splittable
             # (serial_tree_learner.cpp:406-417 pruning heuristic).  Both
@@ -945,10 +1003,11 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
             res2, fok2 = jax.vmap(find, in_axes=(0, 0, 0, 0, None))(
                 hist2, pg2, ph2, pc2, fok_parent)
             res2 = _depth_gate(res2, child_depth, cfg.max_depth)
-            pair = jnp.stack([l, new_leaf])
             feat_ok = state.feat_ok.at[pair].set(fok2 & fok_parent[None, :],
                                                  unique_indices=True)
-            splits = _update_splits(splits, pair, res2)
+            splits = _update_splits(
+                splits, pair, res2,
+                skip=() if cfg.has_categorical else ("is_cat", "cat_bins"))
             return _LoopState(i + 1, order, obins, ow, leaf_start,
                               leaf_cnt, hist_store, feat_ok, splits, tree)
 
@@ -959,6 +1018,13 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None) -> Callable:
         row_leaf = _row_leaf_from_intervals(state.order, state.leaf_start,
                                             state.leaf_cnt, n)
         return state.tree, row_leaf
+
+    if step_limit:
+        # profiler entry: traced step cap first, unpacked layout only
+        def grow_tree_limited(max_steps, bins, gw, hw, cw, meta, feat_valid):
+            return grow_impl(bins, bins, gw, hw, cw, meta, feat_valid,
+                             max_steps=max_steps)
+        return grow_tree_limited
 
     if pack_plan is None:
         # keep the historical 6-arg signature: histogram from the same

