@@ -263,17 +263,21 @@ def child_main():
     from lightgbm_tpu.data.dataset import construct
     from lightgbm_tpu.objectives import create_objective
     from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.obs import memory as obs_memory
     from lightgbm_tpu.obs import trace as obs_trace
     from lightgbm_tpu.obs.counters import counters as obs_counters
     from lightgbm_tpu.utils import log as _log
 
     _log.set_verbosity(-1)
     # telemetry: fresh counters per rung so the observed-kernel evidence is
-    # THIS child's; BENCH_TRACE collects a span trace alongside the JSON
+    # THIS child's; BENCH_TRACE collects a span trace alongside the JSON.
+    # Memory accounting is always on for the measured child — every bench
+    # JSON carries a "memory" block (predicted + measured peak bytes)
     obs_counters.reset()
     bench_trace = os.environ.get("BENCH_TRACE", "")
     if bench_trace:
         obs_trace.start(bench_trace)
+    obs_memory.start()
     platform = jax.devices()[0].platform
     params = {
         "objective": "binary",
@@ -332,6 +336,38 @@ def child_main():
     # BEFORE the leaves-sweep micro-rung trains its extra boosters.
     observed = obs_counters.observed_kernel()
 
+    # device-memory evidence, also snapshotted BEFORE the leaves sweep so
+    # its extra boosters never inflate the measured number: the predicted
+    # peak (obs/memory.predict_hbm fit model, pre-flight recorded it at
+    # booster setup) against the measured peak (TPU memory_stats, or the
+    # live-array census on the CPU rung — the predicted-vs-measured
+    # agreement tests/test_memory.py pins within the documented tolerance)
+    mem_monitor = obs_memory.get_memory()
+    mem_monitor.sample(site="bench_end")
+    pred = getattr(booster, "memory_prediction", None) or \
+        obs_memory.predict_hbm(rows=booster.num_data,
+                               features=int(ds.binned.shape[1]),
+                               bins=params["max_bin"],
+                               leaves=params["num_leaves"])
+    measured_peak = mem_monitor.measured_peak()
+    mem_expected = (pred["peak_bytes"]
+                    if mem_monitor.source == "memory_stats"
+                    else pred["resident_bytes"])
+    memory_block = {
+        "predicted_peak_bytes": pred["peak_bytes"],
+        "predicted_resident_bytes": pred["resident_bytes"],
+        "predicted_components": dict(
+            sorted({**pred["residents"], **pred["transients"]}.items(),
+                   key=lambda kv: -kv[1])[:6]),
+        "measured_peak_bytes": measured_peak,
+        "measured_source": mem_monitor.source,
+        "measured_vs_predicted": round(measured_peak / mem_expected, 3)
+        if mem_expected else None,
+        "top_residents": mem_monitor.top_residents(),
+        "device_capacity_bytes": obs_memory.device_capacity(),
+    }
+    sys.stderr.write(f"bench: memory {json.dumps(memory_block)}\n")
+
     # deep-tree fixed-cost micro-rung (31 vs 255 leaves, <= 200k rows):
     # default on for the cpu rung, opt-in (BENCH_LEAVES_SWEEP=1) on tpu
     sweep_flag = os.environ.get("BENCH_LEAVES_SWEEP", "")
@@ -377,6 +413,7 @@ def child_main():
         "vs_baseline": round(trees_per_sec / baseline, 4),
         "link": link,
         "telemetry": telemetry,
+        "memory": memory_block,
     }
     if leaves_sweep is not None:
         result["leaves_sweep"] = leaves_sweep

@@ -29,6 +29,7 @@ from .config import Config
 from .data.dataset import TrainingData
 from .grower import FeatureMeta, GrowerConfig, make_grower
 from .metrics import Metric, create_metric, default_metric_for_objective
+from .obs import memory as obs_memory
 from .obs import trace as obs_trace
 from .obs.counters import counters as obs_counters
 from .ops.histogram import on_tpu
@@ -290,6 +291,73 @@ class GBDT:
 
         self._update_score = _update_score
 
+        # device-memory observability (obs/memory.py): owner tags for the
+        # live-array census (weakly held — never keeps this booster alive)
+        # and the pre-compile HBM pre-flight.  Runs BEFORE the first grow
+        # call compiles anything, so a shape that cannot fit fails here in
+        # milliseconds instead of minutes into a capture window.
+        obs_memory.register_residents(self._memory_residents)
+        self._memory_preflight(cfg, train)
+
+    def _memory_residents(self) -> Dict[str, list]:
+        """Owner-tagged persistent device arrays for the live census
+        (obs/memory.live_census): binned matrix, packed histogram copy,
+        scores (+ the rollback stash), bagging vectors, subset gather
+        buffers, valid-set arrays, pending pipelined trees."""
+        res: Dict[str, list] = {
+            "binned": [self.bins],
+            "scores": [self.scores],
+            "bagging": [self._bag_weight, self._bag_cnt],
+        }
+        if self._hist_bins is not None:
+            res["packed"] = [self._hist_bins]
+        if self.objective is not None:
+            # labels + the objective's derived per-row device vectors
+            # (binary: label sign/weight; ranking: query maps, gains, ...)
+            res["objective"] = [v for v in vars(self.objective).values()
+                                if hasattr(v, "nbytes")
+                                and hasattr(v, "dtype")]
+        stash = getattr(self, "_score_stash", None)
+        if stash is not None:
+            res["scores"] = res["scores"] + [stash[1]] + list(stash[2])
+        if self._subset_state is not None:
+            res["subset_gather"] = [a for a in self._subset_state
+                                    if a is not None]
+        if self.valid_sets:
+            res["valid"] = [a for vs in self.valid_sets
+                            for a in (vs.bins, vs.scores)]
+        if self._pending:
+            res["pending_trees"] = [a for rec in self._pending
+                                    for a in jax.tree.leaves(rec["arrays"])]
+        return res
+
+    def _memory_preflight(self, cfg: Config, train: TrainingData) -> None:
+        """Predict the training's peak device bytes from the constructed
+        shapes and compare against the device capacity / ``hbm_budget``
+        (obs/memory.preflight) before the grower compiles."""
+        plan = self._pack_plan
+        pred = obs_memory.predict_hbm(
+            rows=self.num_data,
+            features=int(np.shape(self.bins)[1]),
+            bins=self.grower_cfg.max_bin,
+            leaves=self.grower_cfg.num_leaves,
+            num_class=self.num_class,
+            bin_bytes=int(self.bins.dtype.itemsize),
+            packed_cols=(plan.num_storage_cols if plan is not None else 0),
+            valid_rows=sum(vs.data.num_data for vs in self.valid_sets),
+            ordered_bins=self.grower_cfg.ordered_bins == "on",
+            gather_words=(self.grower_cfg.gather_words == "on"
+                          or (self.grower_cfg.gather_words == "auto"
+                              and _on_tpu())),
+            bucket_min_log2=self.grower_cfg.bucket_min_log2)
+        self.memory_prediction = pred
+        obs_memory.preflight(
+            pred, hbm_budget=cfg.hbm_budget,
+            context=f"{self.num_data} rows x "
+                    f"{int(np.shape(self.bins)[1])} cols, "
+                    f"{self.grower_cfg.num_leaves} leaves, "
+                    f"{self.grower_cfg.max_bin} bins")
+
     def _setup_grower(self, cfg: Config, train: TrainingData) -> None:
         """Select the tree learner (CreateTreeLearner analogue):
         serial on one device; data/feature/voting over the device mesh.
@@ -378,6 +446,11 @@ class GBDT:
                             "in use (devices=%d, mesh_devices=%d); falling "
                             "back to serial", cfg.tree_learner, n_devices,
                             cfg.mesh_devices)
+                obs_counters.event(
+                    "layout_downgrade", stage="boosting",
+                    requested=f"tree_learner={cfg.tree_learner}",
+                    resolved="serial",
+                    reason="only one device is in use")
             self.bins = jnp.asarray(self.bins)
             if self._hist_bins is not None:
                 self._hist_bins = jnp.asarray(self._hist_bins)
@@ -620,7 +693,12 @@ class GBDT:
         (gbdt.cpp:465-581 TrainOneIter).  Each iteration is one telemetry
         span; the per-phase spans inside come from ``self.timers``."""
         with obs_trace.get_tracer().span("iteration", index=int(self.iter_)):
-            return self._train_one_iter_inner(grad, hess)
+            stop = self._train_one_iter_inner(grad, hess)
+        # per-iteration device-memory gauge (no-op singleton when memory
+        # observability is off; armed it is a host-side read — it rides
+        # the fetches the loop already does, adding no syncs of its own)
+        obs_memory.get_memory().sample(site="iteration")
+        return stop
 
     def _train_one_iter_inner(self, grad: Optional[np.ndarray] = None,
                               hess: Optional[np.ndarray] = None) -> bool:

@@ -231,6 +231,13 @@ class Config:
                                      # grad->0 / hess->1 on device.  Every
                                      # trip emits a structured `nonfinite`
                                      # obs event.
+    hbm_budget: float = 0.0          # device-memory pre-flight budget in
+                                     # BYTES (obs/memory.predict_hbm vs
+                                     # docs/MEMORY.md): 0 warns only when
+                                     # the predicted peak exceeds the
+                                     # detected device capacity; > 0
+                                     # raises BEFORE the grower compiles
+                                     # when the predicted peak exceeds it
     fault_inject: str = ""           # deterministic fault-injection spec,
                                      # e.g. nan_grad@3,torn_checkpoint@4,
                                      # collective_fail_once (utils/faults.py;
@@ -466,6 +473,10 @@ def check_param_conflicts(cfg: Config) -> None:
             parse_spec(cfg.fault_inject)
         except ValueError as e:
             log.fatal("%s", e)
+    if cfg.hbm_budget < 0:
+        log.fatal("hbm_budget must be >= 0 bytes (0 = warn-only pre-flight "
+                  "against the detected device capacity); got %r",
+                  cfg.hbm_budget)
     if cfg.collective_timeout <= 0:
         log.fatal("collective_timeout must be positive; got %r",
                   cfg.collective_timeout)

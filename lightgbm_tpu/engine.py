@@ -53,6 +53,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # Chrome-trace span file; telemetry=true enables counters/spans without
     # a file.  The counter registry is reset per training so two runs in
     # one process never blur their kernel-identity evidence.
+    from .obs import memory as obs_memory
     from .obs import trace as obs_trace
     from .obs.counters import counters as obs_counters
     trace_path = str(params.get("trace_path", "") or "")
@@ -62,6 +63,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if telemetry_on:
         obs_counters.reset()
         obs_trace.start(trace_path or None)
+        # device-memory accounting rides the same switch: per-iteration /
+        # per-phase samples are host-side reads (memory_stats on TPU, a
+        # live-array census on CPU) — zero added device synchronizations
+        obs_memory.start()
     # deterministic fault injection (utils/faults.py): a param-armed plan is
     # scoped to THIS training; an env-armed plan stays process-wide
     fault_spec = str(params.get("fault_inject", "") or "")
@@ -254,6 +259,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     # a gauge is best-effort, but anything beyond a size
                     # that won't coerce to int is a real bug — let it raise
                     log.debug("grower_jit_entries gauge unavailable: %s", e)
+            # flush the memory summary (peak gauge + top residents event)
+            # BEFORE the trace writes its final counter snapshot, so the
+            # trace file carries the whole memory story
+            obs_memory.stop()
             obs_trace.stop()
         if fault_spec:
             faults_mod.restore(prev_faults)

@@ -458,6 +458,10 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
             if cfg.gather_words == "on":
                 log.warning("gather_words=on ignored: bin dtype %s is wider "
                             "than 2 bytes", hbins.dtype)
+                obs_counters.event(
+                    "layout_downgrade", stage="grower",
+                    requested="gather_words=on", resolved="off",
+                    reason=f"bin dtype {hbins.dtype} is wider than 2 bytes")
             use_words = "off"
         # leaf-ordered mode (OrderedSparseBin analogue,
         # src/io/ordered_sparse_bin.hpp): a physically leaf-ordered copy of
@@ -475,6 +479,10 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
             if cfg.gather_words == "on":
                 log.warning("gather_words=on ignored: ordered_bins=on "
                             "replaces the histogram row gather entirely")
+                obs_counters.event(
+                    "layout_downgrade", stage="grower",
+                    requested="gather_words=on", resolved="off",
+                    reason="ordered_bins=on replaces the row gather")
             use_words = "off"         # nothing left to gather
         if cfg.partition_impl == "compact":
             # the A/B harness must never record scatter numbers labeled
@@ -483,15 +491,30 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                 log.warning("partition_impl=compact falls back to scatter: "
                             "%d rows exceed the f32-exact order-id limit "
                             "(2^24)", n)
+                obs_counters.event(
+                    "layout_downgrade", stage="grower",
+                    requested="partition_impl=compact", resolved="scatter",
+                    reason=f"{n} rows exceed the f32-exact order-id "
+                           "limit (2^24)")
             if cfg.bucket_min_log2 < 9:
                 log.warning("partition_impl=compact falls back to scatter "
                             "for buckets below 512 rows "
                             "(pallas_bucket_min_log2=%d)",
                             cfg.bucket_min_log2)
+                obs_counters.event(
+                    "layout_downgrade", stage="grower",
+                    requested="partition_impl=compact", resolved="scatter",
+                    reason=f"buckets below 512 rows (bucket_min_log2="
+                           f"{cfg.bucket_min_log2})")
             if use_ordered and dtype != jnp.float32:
                 log.warning("partition_impl=compact falls back to scatter: "
                             "ordered_bins payload dtype %s is not float32",
                             dtype)
+                obs_counters.event(
+                    "layout_downgrade", stage="grower",
+                    requested="partition_impl=compact", resolved="scatter",
+                    reason=f"ordered_bins payload dtype {dtype} is not "
+                           "float32")
         # gather panel: the histogram's data movement is per-INDEX, not
         # per-byte (measured 12.6 ns/row for a 28-byte row gather, and the
         # same class for a single f32 column) — so the three separate
@@ -506,6 +529,11 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
             log.warning("gather_panel=on ignored: it needs gather_words on "
                         "and float32 weights (words=%s, dtype=%s)",
                         use_words, dtype)
+            obs_counters.event(
+                "layout_downgrade", stage="grower",
+                requested="gather_panel=on", resolved="off",
+                reason=f"needs gather_words on and float32 weights "
+                       f"(words={use_words}, dtype={dtype})")
         # gen-2 fused-gather histogram rung: the kernel DMAs the indexed
         # panel rows itself, so the gather-bucket lax.switch (and its pow2
         # staging buffer) is RETIRED on this path — no ``branches`` are

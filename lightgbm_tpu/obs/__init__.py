@@ -1,19 +1,25 @@
-"""lightgbm_tpu.obs — structured telemetry: spans, counters, collectives.
+"""lightgbm_tpu.obs — structured telemetry: spans, counters, collectives,
+device memory.
 
-Three pillars (see docs/OBSERVABILITY.md):
+Four pillars (see docs/OBSERVABILITY.md):
 
 * :mod:`.trace` — nested-span tracer; no-op when disabled, Chrome-trace
   JSON/JSONL + ``jax.profiler.TraceAnnotation`` mirroring when enabled;
 * :mod:`.counters` — process-wide counters/events (histogram-kernel
   dispatch identity, layout downgrades, collective bytes);
-* :mod:`.report` — ``python -m lightgbm_tpu.obs <trace>`` renders the
-  per-phase / per-kernel markdown tables.
+* :mod:`.memory` — device-memory observability: live HBM accounting
+  (``memory_stats`` / tagged live-array census), compiled-executable
+  ``memory_analysis`` capture, the ``predict_hbm`` fit-predictor and the
+  pre-compile ``hbm_budget`` pre-flight;
+* :mod:`.report` — ``python -m lightgbm_tpu.obs <trace>...`` renders the
+  per-phase / per-kernel / memory markdown tables (multiple trace files
+  merge rank-tagged).
 
 Enable from training via ``engine.train(params={"trace_path": ...})`` or
 ``telemetry=true``; from the bench via ``BENCH_TRACE=<path>``.
 """
-from . import trace
+from . import memory, trace
 from .counters import counters
 from .trace import get_tracer
 
-__all__ = ["trace", "counters", "get_tracer"]
+__all__ = ["memory", "trace", "counters", "get_tracer"]

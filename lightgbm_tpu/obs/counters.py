@@ -37,7 +37,11 @@ def _tag_key(tags: Dict[str, Any]) -> str:
 class CounterRegistry:
     """Thread-safe registry: counters[name][tag_key] -> number."""
 
-    MAX_EVENTS = 512     # bounded: telemetry must never grow without limit
+    MAX_EVENTS = 512     # ring buffer: telemetry must never grow host
+    #                      memory without bound — a long training with
+    #                      telemetry on keeps the newest MAX_EVENTS events
+    #                      and counts the overflow (``events_dropped``)
+    #                      instead of leaking
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -45,6 +49,7 @@ class CounterRegistry:
         self._gauges: Dict[str, float] = {}
         self._events: collections.deque = collections.deque(
             maxlen=self.MAX_EVENTS)
+        self._events_dropped = 0
 
     # ------------------------------------------------------------- writers
 
@@ -59,15 +64,23 @@ class CounterRegistry:
             self._gauges[name] = value
 
     def event(self, name: str, **fields) -> None:
-        """Record a structured event (layout downgrade, recompile, ...)."""
+        """Record a structured event (layout downgrade, recompile, ...).
+        Storage is a bounded ring: at capacity the OLDEST event is evicted
+        and ``events_dropped`` counts the loss (surfaced in snapshots and
+        the report) so truncation is visible, never silent."""
+        from .trace import process_index   # lazy: avoid import cycles
         with self._lock:
-            self._events.append({"event": name, **fields})
+            if len(self._events) == self._events.maxlen:
+                self._events_dropped += 1
+            self._events.append({"event": name, "proc": process_index(),
+                                 **fields})
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._events.clear()
+            self._events_dropped = 0
 
     # ------------------------------------------------------------- readers
 
@@ -85,12 +98,19 @@ class CounterRegistry:
         return evs if name is None else [e for e in evs
                                          if e.get("event") == name]
 
+    def events_dropped(self) -> int:
+        with self._lock:
+            return self._events_dropped
+
     def snapshot(self) -> Dict[str, Any]:
+        from .trace import process_index
         with self._lock:
             return {"counters": {n: dict(b)
                                  for n, b in self._counters.items()},
                     "gauges": dict(self._gauges),
-                    "events": list(self._events)}
+                    "events": list(self._events),
+                    "events_dropped": self._events_dropped,
+                    "process_index": process_index()}
 
     # --------------------------------------------- derived: kernel identity
 

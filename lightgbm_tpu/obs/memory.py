@@ -1,0 +1,452 @@
+"""Device-memory observability: live HBM accounting, compiled-executable
+memory analysis, and the measured fit-predictor.
+
+On TPU the hard wall is HBM, not FLOPs — ``docs/MEMORY.md``'s Epsilon-like
+``hist_store`` alone is 1.56 GB — and before this module that table was
+hand-computed: nothing ever measured actual device bytes, so a wrong
+estimate surfaced as an opaque on-chip OOM during a scarce capture window.
+Three legs, each independently usable:
+
+* **live accounting** — :class:`MemoryMonitor`, armed through
+  :func:`start`/:func:`stop` with the established no-op-singleton
+  discipline (``obs/trace.py``, ``utils/faults.py``): when disarmed the
+  active monitor is the shared :data:`NULL_MEMORY` whose every method is a
+  constant no-op, so the instrumented hot paths (per-iteration sample,
+  per-phase span annotation) cost one attribute read.  Armed, each sample
+  reads ``device.memory_stats()`` where the backend provides it (TPU) and
+  falls back to a ``jax.live_arrays()`` census elsewhere (CPU) — both are
+  host-side reads, so sampling adds ZERO host<->device synchronizations
+  (the rule PR 3's non-finite guards established).  Census bytes are
+  attributed to owner tags (binned matrix, scores, bagging, ...) through
+  resident providers the boosting driver registers
+  (:func:`register_residents`).
+
+* **static analysis** — :func:`executable_memory` wraps
+  ``compiled.memory_analysis()`` (argument/output/temp/alias bytes of a
+  jitted executable) into a plain dict, records the numbers as obs
+  gauges + one ``exec_memory`` event, and is what
+  ``scripts/profile_grow_steps.py`` and the ``tests/test_grow_jaxpr.py``
+  byte-budget ratchet consume: a copy-insertion regression now fails a
+  CPU test instead of an on-chip capture window.
+
+* **fit prediction** — :func:`predict_hbm` codifies the
+  ``docs/MEMORY.md`` analytic model (regenerated from this function by
+  ``scripts/gen_memory_doc.py``); :func:`preflight` compares the
+  predicted peak against the device capacity (or an explicit
+  ``hbm_budget`` param) BEFORE the grower compiles, turning on-chip OOMs
+  into actionable pre-flight diagnostics.  Predicted-vs-measured
+  agreement is validated on CPU in tier-1 (``tests/test_memory.py``)
+  within the documented tolerance (see :data:`RESIDENT_TOLERANCE`).
+"""
+from __future__ import annotations
+
+import json
+import weakref
+from typing import Any, Callable, Dict, List, Optional
+
+from .counters import counters
+
+# Documented predicted-vs-measured tolerance for the RESIDENT bytes model
+# on the CPU live-array census (tests/test_memory.py, bench.py memory
+# block): the census counts real allocator bytes while the model counts
+# ideal array payloads, so padding/rounding plus small untracked arrays
+# (tree SoA, feature meta, pipeline pending records) make the ratio drift
+# from 1.  The acceptance band is measured/predicted in
+# [1 - RESIDENT_TOLERANCE, 1 + RESIDENT_TOLERANCE].
+RESIDENT_TOLERANCE = 0.35
+
+
+# --------------------------------------------------------------- live stats
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """Normalized ``device.memory_stats()`` or None when the backend does
+    not expose allocator stats (CPU).  Keys (when present):
+    ``bytes_in_use``, ``peak_bytes_in_use``, ``bytes_limit``."""
+    try:
+        import jax
+        dev = device if device is not None else jax.devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_free_block_bytes", "num_allocs"):
+        if key in stats:
+            out[key] = int(stats[key])
+    return out or None
+
+
+# Owner-tag providers: each is a (weakly referenced) zero-arg callable
+# returning {tag: [jax arrays]}.  The boosting driver registers its bound
+# method here at setup; dead boosters drop out automatically.
+_providers: List[Any] = []
+
+
+def register_residents(provider: Callable[[], Dict[str, list]]) -> None:
+    """Register an owner-tag provider for the live-array census.  Bound
+    methods are held through ``weakref.WeakMethod`` so a provider never
+    keeps its booster alive."""
+    try:
+        ref = weakref.WeakMethod(provider)
+    except TypeError:
+        ref = weakref.ref(provider)
+    _providers.append(ref)
+
+
+def live_census() -> Dict[str, Any]:
+    """One pass over ``jax.live_arrays()``: total bytes plus a per-owner-tag
+    breakdown.  Arrays no registered provider claims land in ``untagged``
+    (jit-internal temporaries never appear here at all — XLA workspace is
+    not a jax array; on TPU it is covered by ``memory_stats`` instead)."""
+    import jax
+    tag_of: Dict[int, str] = {}
+    live_refs = []
+    for ref in _providers:
+        fn = ref()
+        if fn is None:
+            continue
+        live_refs.append(ref)
+        try:
+            owned = fn()
+        except Exception:
+            continue
+        for tag, arrays in owned.items():
+            for a in arrays:
+                if a is not None:
+                    tag_of[id(a)] = tag
+    _providers[:] = live_refs
+    by_tag: Dict[str, int] = {}
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            nbytes = int(a.nbytes)
+        except Exception:
+            continue
+        total += nbytes
+        tag = tag_of.get(id(a), "untagged")
+        by_tag[tag] = by_tag.get(tag, 0) + nbytes
+    return {"total_bytes": total, "by_tag": by_tag}
+
+
+class NullMemoryMonitor:
+    """Disarmed monitor: every operation is a constant no-op, shared
+    process-wide (the tracer/faults singleton discipline) so the hot-loop
+    sample/annotate sites never allocate when memory observability is
+    off."""
+    enabled = False
+    source = None
+
+    def sample(self, site: str = "") -> Optional[int]:
+        return None
+
+    def annotate(self, span) -> None:
+        pass
+
+    def measured_peak(self) -> int:
+        return 0
+
+    def baseline(self) -> int:
+        return 0
+
+    def top_residents(self, k: int = 6) -> List[Dict[str, Any]]:
+        return []
+
+    def summary(self) -> Dict[str, Any]:
+        return {}
+
+
+NULL_MEMORY = NullMemoryMonitor()
+
+
+class MemoryMonitor:
+    """Armed monitor.  ``source`` names the evidence backing the numbers:
+    ``memory_stats`` (TPU allocator truth, includes XLA workspace) or
+    ``live_census`` (CPU fallback: persistent jax arrays only)."""
+    enabled = True
+
+    def __init__(self):
+        self._peak = 0
+        self._last_census: Optional[Dict[str, Any]] = None
+        stats = device_memory_stats()
+        self.source = "memory_stats" if stats else "live_census"
+        self._baseline = (stats["bytes_in_use"] if stats
+                          and "bytes_in_use" in stats
+                          else live_census()["total_bytes"])
+        counters.gauge("memory_baseline_bytes", self._baseline)
+
+    def sample(self, site: str = "") -> Optional[int]:
+        """Record the current device occupancy; returns the sampled bytes.
+        Host-side reads only — never synchronizes the device."""
+        stats = device_memory_stats() if self.source == "memory_stats" \
+            else None
+        if stats:
+            in_use = stats.get("bytes_in_use", 0)
+            peak = stats.get("peak_bytes_in_use", in_use)
+        else:
+            self._last_census = live_census()
+            in_use = peak = self._last_census["total_bytes"]
+        self._peak = max(self._peak, peak)
+        counters.gauge("memory_bytes_in_use", in_use)
+        counters.gauge("memory_peak_bytes", self._peak)
+        return in_use
+
+    def annotate(self, span) -> None:
+        """Attach the sampled bytes to a recording tracer span (the
+        ``PhaseTimers`` hook).  A ``NULL_SPAN`` has no ``_args`` and is
+        skipped, so the disabled-tracer fast path stays allocation-free."""
+        args = getattr(span, "_args", None)
+        if args is None:
+            return
+        b = self.sample(site="phase")
+        if b is not None:
+            args["peak_bytes"] = int(self._peak)
+
+    def measured_peak(self) -> int:
+        return self._peak
+
+    def baseline(self) -> int:
+        return self._baseline
+
+    def top_residents(self, k: int = 6) -> List[Dict[str, Any]]:
+        """Largest owner tags of the most recent census (taken on demand
+        when the monitor rides ``memory_stats`` — the tag breakdown is a
+        census-only view either way)."""
+        census = self._last_census or live_census()
+        tags = sorted(census["by_tag"].items(), key=lambda kv: -kv[1])
+        return [{"tag": t, "bytes": b} for t, b in tags[:k]]
+
+    def summary(self) -> Dict[str, Any]:
+        return {"source": self.source,
+                "baseline_bytes": self._baseline,
+                "measured_peak_bytes": self._peak,
+                "top_residents": self.top_residents()}
+
+
+_active: Any = NULL_MEMORY
+
+
+def get_memory():
+    """The process-wide active monitor (NULL_MEMORY when disarmed)."""
+    return _active
+
+
+def start() -> MemoryMonitor:
+    """Arm a recording monitor as the process-wide active one."""
+    global _active
+    _active = MemoryMonitor()
+    return _active
+
+
+def stop() -> Dict[str, Any]:
+    """Disarm; flushes the final summary into the counter registry (one
+    ``memory_summary`` event + gauges) so a trace written afterwards is
+    self-contained, and returns it."""
+    global _active
+    mon, _active = _active, NULL_MEMORY
+    if not mon.enabled:
+        return {}
+    mon.sample(site="final")
+    summ = mon.summary()
+    counters.gauge("memory_measured_peak_bytes", summ["measured_peak_bytes"])
+    counters.event("memory_summary", **{
+        k: v for k, v in summ.items() if k != "top_residents"},
+        top_residents=[f"{r['tag']}={r['bytes']}"
+                       for r in summ["top_residents"]])
+    return summ
+
+
+# ---------------------------------------------------------- static analysis
+
+
+def executable_memory(compiled, label: str = "") -> Optional[Dict[str, int]]:
+    """``compiled.memory_analysis()`` as a plain dict (bytes):
+    ``argument/output/temp/alias/generated_code`` plus ``peak_bytes``
+    (argument + output + temp — the executable's device footprint while it
+    runs).  With ``label`` the numbers also land as obs gauges
+    (``exec_<label>_{temp,peak}_bytes``) and one ``exec_memory`` event.
+    Returns None when the backend reports nothing."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+    out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                         + out["temp_bytes"])
+    if label:
+        counters.gauge(f"exec_{label}_temp_bytes", out["temp_bytes"])
+        counters.gauge(f"exec_{label}_peak_bytes", out["peak_bytes"])
+        counters.event("exec_memory", label=label, **out)
+    return out
+
+
+def analyze_jitted(fn, *args, label: str = "") -> Optional[Dict[str, int]]:
+    """AOT lower+compile ``fn`` at ``args`` (arrays or ShapeDtypeStructs)
+    and return :func:`executable_memory` of the result.  This compiles —
+    use it from profilers/tests, never from a training hot path (the
+    persistent compilation cache makes repeats cheap)."""
+    import jax
+    compiled = jax.jit(fn).lower(*args).compile()
+    return executable_memory(compiled, label=label)
+
+
+# ------------------------------------------------------------ fit predictor
+
+
+def _pow2_at_least(n: int, floor: int = 1) -> int:
+    p = max(int(floor), 1)
+    while p < n:
+        p *= 2
+    return p
+
+
+def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
+                num_class: int = 1, bin_bytes: Optional[int] = None,
+                packed_cols: int = 0, valid_rows: int = 0,
+                ordered_bins: bool = False, gather_words: bool = False,
+                bucket_min_log2: int = 6) -> Dict[str, Any]:
+    """Analytic device-memory model of one training (the codified
+    ``docs/MEMORY.md`` audit; that doc's table is generated from this
+    function by ``scripts/gen_memory_doc.py``).
+
+    ``features`` counts PHYSICAL binned columns (post-EFB).  Components
+    split into **residents** — persistent jax arrays the boosting driver
+    holds between iterations, what the CPU live-array census sees — and
+    **transients** — XLA workspace of the jitted grower (gather staging,
+    ``order``, ``hist_store``), visible only to ``memory_stats`` on TPU.
+    ``peak_bytes`` = residents + transients; ``resident_bytes`` is the
+    number the census-based CPU validation compares against (tolerance
+    :data:`RESIDENT_TOLERANCE`).
+    """
+    rows = int(rows)
+    features = int(features)
+    if bin_bytes is None:
+        bin_bytes = 1 if bins < 256 else 2
+    maxbuf = _pow2_at_least(rows, 1 << bucket_min_log2)
+    residents = {
+        # the binned matrix [N, C] (+ the nibble-packed histogram copy)
+        "binned": rows * features * bin_bytes,
+        "packed": rows * int(packed_cols),
+        # train scores live twice per class: the current array + the
+        # iteration-start rollback stash (boosting.train_one_iter)
+        "scores": 2 * num_class * rows * 4,
+        # per-iteration gradient/hessian pair, alive through the tree phase
+        "grad_hess": 2 * num_class * rows * 4,
+        # the objective's label + ~2 derived per-row device vectors
+        # (binary's sign/weight; a rough but measured-against constant)
+        "objective": 3 * rows * 4,
+        # bagging weight + count vectors
+        "bagging": 2 * rows * 4,
+        # each valid set: binned matrix + per-class scores
+        "valid": int(valid_rows) * (features * bin_bytes + num_class * 4),
+    }
+    words_bytes = (-(-features * bin_bytes // 4) + 3) * 4  # [W+3] u32 panel
+    row_bytes = features * bin_bytes + 12                  # bins + g,h,c
+    transients = {
+        # sentinel-padded copy of the histogram inputs (hbins_pad + the
+        # three weight vectors; the word/panel layout on TPU)
+        "staging": (rows + 1) * (words_bytes if gather_words else row_bytes),
+        # order [N + maxbuf] i32 + the final row->leaf map [N] i32
+        "order_partition": (rows + maxbuf) * 4 + rows * 4,
+        # the per-leaf histogram pool [L, F, B, 3] f32
+        "hist_store": leaves * features * bins * 3 * 4,
+        # the pow2 gather buffer for the largest bucket
+        "gather_buffer": maxbuf * (words_bytes if gather_words
+                                   else row_bytes),
+        # leaf-ordered copies ride the carry when ordered_bins=on
+        "ordered_copies": ((rows + maxbuf) * row_bytes
+                           if ordered_bins else 0),
+    }
+    resident_bytes = sum(residents.values())
+    transient_bytes = sum(transients.values())
+    return {
+        "inputs": {"rows": rows, "features": features, "bins": bins,
+                   "leaves": leaves, "num_class": num_class,
+                   "bin_bytes": bin_bytes, "packed_cols": int(packed_cols),
+                   "valid_rows": int(valid_rows),
+                   "ordered_bins": bool(ordered_bins),
+                   "gather_words": bool(gather_words)},
+        "residents": residents,
+        "transients": transients,
+        "resident_bytes": resident_bytes,
+        "transient_bytes": transient_bytes,
+        "peak_bytes": resident_bytes + transient_bytes,
+    }
+
+
+def device_capacity(device=None) -> Optional[int]:
+    """Total device memory in bytes when the backend reports it (TPU
+    ``bytes_limit``), else None (CPU host memory is not the budgeted
+    resource)."""
+    stats = device_memory_stats(device)
+    return stats.get("bytes_limit") if stats else None
+
+
+def preflight(pred: Dict[str, Any], hbm_budget: float = 0.0,
+              context: str = "") -> Dict[str, Any]:
+    """Compare a :func:`predict_hbm` prediction against the device budget
+    BEFORE anything compiles.
+
+    ``hbm_budget`` > 0 is a hard budget in bytes: exceeding it raises
+    (``log.fatal``) with the component breakdown — the whole point is to
+    fail in seconds on host instead of minutes into a capture window.
+    With no explicit budget the check is advisory: when the backend
+    reports a capacity (TPU) and the predicted peak exceeds it, a warning
+    names the dominant components.  Every outcome lands as one
+    ``hbm_preflight`` obs event + a ``hbm_predicted_peak_bytes`` gauge."""
+    from ..utils import log
+    peak = int(pred["peak_bytes"])
+    capacity = device_capacity()
+    budget = int(hbm_budget) if hbm_budget and hbm_budget > 0 else None
+    limit = budget if budget is not None else capacity
+    top = sorted({**pred["residents"], **pred["transients"]}.items(),
+                 key=lambda kv: -kv[1])[:3]
+    detail = ", ".join(f"{k}={v / 1e9:.2f} GB" for k, v in top)
+    counters.gauge("hbm_predicted_peak_bytes", peak)
+    verdict = "ok"
+    if limit is not None and peak > limit:
+        verdict = "over_budget" if budget is not None else "over_capacity"
+    counters.event("hbm_preflight", predicted_peak_bytes=peak,
+                   capacity_bytes=capacity, hbm_budget=budget,
+                   verdict=verdict, context=context)
+    if verdict == "over_budget":
+        log.fatal("predicted peak HBM %.2f GB exceeds hbm_budget %.2f GB "
+                  "(%s; top components: %s) — shrink the shape "
+                  "(max_bin/num_leaves/rows) or raise hbm_budget",
+                  peak / 1e9, limit / 1e9, context or "pre-flight", detail)
+    if verdict == "over_capacity":
+        log.warning("predicted peak HBM %.2f GB exceeds device capacity "
+                    "%.2f GB (%s; top components: %s) — an on-chip OOM is "
+                    "likely; set hbm_budget to fail fast",
+                    peak / 1e9, limit / 1e9, context or "pre-flight",
+                    detail)
+    return {"predicted_peak_bytes": peak, "capacity_bytes": capacity,
+            "hbm_budget": budget, "verdict": verdict}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m lightgbm_tpu.obs.memory`` — one JSON snapshot of every
+    device's ``memory_stats`` plus the live-array census; the capture
+    playbook collects one per bench rung."""
+    import jax
+    snap = {"devices": [{"id": int(d.id), "platform": d.platform,
+                         "memory_stats": device_memory_stats(d)}
+                        for d in jax.devices()],
+            "live_census": live_census()}
+    print(json.dumps(snap, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

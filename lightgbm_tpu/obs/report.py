@@ -1,12 +1,18 @@
 """Render a telemetry trace into per-phase / per-kernel markdown tables.
 
-``python -m lightgbm_tpu.obs <trace>`` is the CLI wrapper.  Accepts every
-format ``obs/trace.py`` writes: a Chrome-trace object
+``python -m lightgbm_tpu.obs <trace>...`` is the CLI wrapper.  Accepts
+every format ``obs/trace.py`` writes: a Chrome-trace object
 (``{"traceEvents": [...]}``), a bare JSON array, or JSONL (one event per
 line — a killed process leaves a readable prefix, so partial files parse
 too).  The trace is self-contained: the final ``telemetry.summary`` event
 carries the counter-registry snapshot (kernel dispatch identity, layout
-downgrades, collective bytes) alongside the span timeline.
+downgrades, collective bytes, memory gauges) alongside the span timeline.
+
+Multiple trace files — one per process of a multi-host training — merge
+into ONE report: every span is rank-tagged (``[r<k>] span``, from the
+``proc`` stamp each event carries, falling back to file order) and the
+per-file counter summaries render side by side, so cross-rank skew is
+visible in a single phase table instead of needing N terminals.
 """
 from __future__ import annotations
 
@@ -38,6 +44,23 @@ def load_events(path: str) -> List[dict]:
     return list(obj)
 
 
+def load_events_ranked(paths: List[str]) -> List[tuple]:
+    """Load several trace files as ``[(path, rank, events), ...]``.
+
+    The rank is the ``proc`` stamp the events carry (multi-host traces);
+    when the stamps do not distinguish the files (e.g. two single-host
+    runs, both proc 0), file order does."""
+    loaded = []
+    for i, p in enumerate(paths):
+        events = load_events(p)
+        procs = {e["proc"] for e in events if "proc" in e}
+        loaded.append([p, procs.pop() if len(procs) == 1 else i, events])
+    if len({r for _, r, _ in loaded}) < len(loaded):
+        for i, entry in enumerate(loaded):
+            entry[1] = i
+    return [tuple(entry) for entry in loaded]
+
+
 def summary_payload(events: List[dict], kind: str) -> Optional[dict]:
     """Last embedded ``telemetry.summary`` payload of the given kind."""
     out = None
@@ -64,14 +87,19 @@ def phase_table(events: List[dict],
     11.2 s total of which 10.8 s was the first, compile-inclusive
     firing)."""
     agg: Dict[str, List[tuple]] = {}
+    peak: Dict[str, int] = {}
     for ev in events:
         if ev.get("ph") != "X":
             continue
-        is_traced = bool(ev.get("args", {}).get("traced"))
+        args = ev.get("args", {})
+        is_traced = bool(args.get("traced"))
         if traced is not None and is_traced != traced:
             continue
         agg.setdefault(ev["name"], []).append(
             (float(ev.get("ts", 0)), float(ev.get("dur", 0)) / 1e3))
+        if "peak_bytes" in args:    # memory monitor phase annotation
+            peak[ev["name"]] = max(peak.get(ev["name"], 0),
+                                   int(args["peak_bytes"]))
     rows = []
     for name, spans in agg.items():
         spans.sort()
@@ -80,6 +108,8 @@ def phase_table(events: List[dict],
                "total_ms": sum(durs),
                "mean_ms": sum(durs) / len(durs),
                "max_ms": max(durs)}
+        if name in peak:
+            row["peak_bytes"] = peak[name]
         if traced is False:
             rest = durs[1:]
             row["first_ms"] = durs[0]
@@ -125,11 +155,80 @@ def _md_table(headers: List[str], rows: List[List[Any]]) -> List[str]:
     return out
 
 
-def render(path: str) -> str:
-    events = load_events(path)
-    snap = summary_payload(events, "counters") or {}
-    counters = snap.get("counters", {})
-    lines = [f"# lightgbm_tpu telemetry report — `{path}`", ""]
+def _memory_lines(snap: dict) -> List[str]:
+    """The report's Memory section: predicted/measured gauges, the
+    pre-flight verdict, executable memory-analysis events, top residents."""
+    gauges = snap.get("gauges", {})
+    events = snap.get("events", [])
+    mem_gauges = {k: v for k, v in gauges.items()
+                  if k.startswith(("memory_", "hbm_")) or (
+                      k.startswith("exec_") and k.endswith("_bytes"))}
+    preflight = [e for e in events if e.get("event") == "hbm_preflight"]
+    summaries = [e for e in events if e.get("event") == "memory_summary"]
+    execs = [e for e in events if e.get("event") == "exec_memory"]
+    if not (mem_gauges or preflight or summaries or execs):
+        return []
+    lines = ["", "## Memory", ""]
+    for k in sorted(mem_gauges):
+        lines.append(f"- `{k}` = {mem_gauges[k] / 1e6:.2f} MB")
+    for e in preflight[-1:]:
+        lines.append(f"- pre-flight: `{e.get('verdict')}` "
+                     f"(predicted {e.get('predicted_peak_bytes', 0) / 1e9:.3f}"
+                     f" GB, capacity {e.get('capacity_bytes')}, "
+                     f"hbm_budget {e.get('hbm_budget')})")
+    for e in summaries[-1:]:
+        lines.append(f"- measured peak ({e.get('source')}): "
+                     f"{e.get('measured_peak_bytes', 0) / 1e6:.2f} MB; "
+                     f"top residents: {e.get('top_residents')}")
+    for e in execs:
+        lines.append(f"- executable `{e.get('label')}`: "
+                     f"temp {e.get('temp_bytes', 0) / 1e6:.2f} MB, "
+                     f"peak {e.get('peak_bytes', 0) / 1e6:.2f} MB")
+    return lines
+
+
+def render(path) -> str:
+    paths = [path] if isinstance(path, str) else list(path)
+    ranked = load_events_ranked(paths)
+    multi = len(ranked) > 1
+    if multi:
+        # rank-tag every SPAN so the merged tables stay attributable; the
+        # embedded telemetry.summary payloads keep their names (they are
+        # read per-file below, never from the merged stream)
+        events = [dict(ev, name=f"[r{rank}] {ev['name']}")
+                  if ev.get("ph") == "X" else ev
+                  for _, rank, evs in ranked for ev in evs]
+        snap = {}
+        counters = {}
+        for _, rank, evs in ranked:
+            rsnap = summary_payload(evs, "counters") or {}
+            for name, buckets in rsnap.get("counters", {}).items():
+                merged = counters.setdefault(name, {})
+                for key, v in buckets.items():
+                    merged[f"proc={rank}," + key if key
+                           else f"proc={rank}"] = v
+            for e in rsnap.get("events", []):
+                snap.setdefault("events", []).append(e)
+            for k, v in rsnap.get("gauges", {}).items():
+                snap.setdefault("gauges", {})[f"[r{rank}] {k}"] = v
+            snap["events_dropped"] = (snap.get("events_dropped", 0)
+                                      + rsnap.get("events_dropped", 0))
+    else:
+        events = ranked[0][2]
+        snap = summary_payload(events, "counters") or {}
+        counters = snap.get("counters", {})
+    title = ", ".join(f"`{p}` (rank {r})" for p, r, _ in ranked) if multi \
+        else f"`{paths[0]}`"
+    lines = [f"# lightgbm_tpu telemetry report — {title}", ""]
+    if multi:
+        for p, rank, evs in ranked:
+            rsnap = summary_payload(evs, "counters") or {}
+            obs = observed_kernel(rsnap.get("counters", {}))
+            if obs is not None:
+                lines.append(f"**rank {rank}** (`{p}`) observed histogram "
+                             f"kernel identity: `{obs}`")
+        if lines[-1] != "":
+            lines.append("")
     obs = observed_kernel(counters)
     if obs is not None:
         lines += [f"**Observed histogram kernel identity:** `{obs}`", ""]
@@ -140,13 +239,18 @@ def render(path: str) -> str:
               "by `steady mean`, not `total`.", ""]
     prows = phase_table(events, traced=False)
     if prows:
+        with_peak = any("peak_bytes" in r for r in prows)
+        headers = ["span", "count", "total ms", "first ms",
+                   "steady mean ms", "max ms"]
+        headers += (["peak MB", ""] if with_peak else [""])
         lines += _md_table(
-            ["span", "count", "total ms", "first ms", "steady mean ms",
-             "max ms", ""],
+            headers,
             [[r["span"], r["count"], f"{r['total_ms']:.3f}",
               f"{r['first_ms']:.3f}", f"{r['steady_mean_ms']:.3f}",
-              f"{r['max_ms']:.3f}",
-              "compile⚠" if r["compile_skewed"] else ""] for r in prows])
+              f"{r['max_ms']:.3f}"]
+             + ([f"{r['peak_bytes'] / 1e6:.1f}" if "peak_bytes" in r
+                 else "-"] if with_peak else [])
+             + ["compile⚠" if r["compile_skewed"] else ""] for r in prows])
     else:
         lines.append("(no spans recorded)")
     trows = phase_table(events, traced=True)
@@ -176,9 +280,14 @@ def render(path: str) -> str:
             ["op", "site", "bytes"],
             [[_split_tags(k).get("op", "?"), _split_tags(k).get("site", "-"),
               int(v)] for k, v in sorted(coll.items())])
+    lines += _memory_lines(snap)
     events_list = snap.get("events", [])
     if events_list:
         lines += ["", "## Structured events", ""]
+        dropped = snap.get("events_dropped", 0)
+        if dropped:
+            lines += [f"(ring buffer overflowed: {dropped} oldest events "
+                      "dropped)", ""]
         for e in events_list[-32:]:
             kind = e.get("event", "?")
             rest = {k: v for k, v in e.items() if k != "event"}
@@ -198,18 +307,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = [a for a in argv if a != "--json"]
     if not argv:
         sys.stderr.write(
-            "usage: python -m lightgbm_tpu.obs [--json] <trace.json[l]>\n")
+            "usage: python -m lightgbm_tpu.obs [--json] "
+            "<trace.json[l]> [<trace2> ...]\n")
         return 2
-    path = argv[0]
     try:
         if as_json:
-            events = load_events(path)
-            print(json.dumps({
-                "phases": phase_table(events),
-                "summary": summary_payload(events, "counters") or {}},
-                indent=1))
+            # machine-readable: one entry per file (rank-tagged) so
+            # tpu_capture_phase2.sh / decide_flips.py consume reports
+            # without re-parsing markdown
+            files = []
+            for p, rank, events in load_events_ranked(argv):
+                summary = summary_payload(events, "counters") or {}
+                files.append({
+                    "path": p, "rank": rank,
+                    "phases": phase_table(events),
+                    "observed_kernel": observed_kernel(
+                        summary.get("counters", {})),
+                    "memory": {
+                        k: v for k, v in summary.get("gauges", {}).items()
+                        if k.startswith(("memory_", "hbm_", "exec_"))},
+                    "events_dropped": summary.get("events_dropped", 0),
+                    "summary": summary})
+            doc = files[0] if len(files) == 1 else {"files": files}
+            print(json.dumps(doc, indent=1))
         else:
-            print(render(path))
+            print(render(argv))
     except BrokenPipeError:      # `... | head` closing the pipe is fine
         try:
             sys.stdout.close()

@@ -39,6 +39,21 @@ from typing import Any, Dict, List, Optional
 # resolved lazily; False once probing failed (jax absent / too old)
 _TraceAnnotation: Any = None
 
+# process index resolved once (multi-host traces from different ranks must
+# stay distinguishable after they are merged into one report)
+_PROC: Any = None
+
+
+def process_index() -> int:
+    global _PROC
+    if _PROC is None:
+        try:
+            import jax
+            _PROC = int(jax.process_index())
+        except Exception:
+            _PROC = 0
+    return _PROC
+
 
 def _jax_annotation(name: str):
     global _TraceAnnotation
@@ -112,7 +127,7 @@ class _Span:
             self._jax.__exit__(*exc)
         ev = {"name": self._name, "ph": "X", "ts": round(self._ts, 3),
               "dur": round(dur, 3), "pid": self._tr.pid,
-              "tid": threading.get_ident()}
+              "proc": self._tr.proc, "tid": threading.get_ident()}
         if self._args:
             ev["args"] = self._args
         self._tr._append(ev)
@@ -127,6 +142,7 @@ class Tracer:
     def __init__(self, path: Optional[str] = None):
         self.path = path
         self.pid = os.getpid()
+        self.proc = process_index()
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
         self._events: List[dict] = []
@@ -146,7 +162,8 @@ class Tracer:
 
     def instant(self, name: str, **args) -> None:
         ev = {"name": name, "ph": "i", "s": "p", "ts": round(self._now_us(), 3),
-              "pid": self.pid, "tid": threading.get_ident()}
+              "pid": self.pid, "proc": self.proc,
+              "tid": threading.get_ident()}
         if args:
             ev["args"] = args
         self._append(ev)

@@ -17,6 +17,7 @@ import contextlib
 import time
 from typing import Dict
 
+from ..obs import memory as obs_memory
 from ..obs import trace as obs_trace
 from . import log
 
@@ -36,6 +37,10 @@ class PhaseTimers:
         try:
             yield
         finally:
+            # attach the phase's peak device bytes to the span it already
+            # emits (both singletons: a no-op unless the tracer AND the
+            # memory monitor are armed; the sample is a host-side read)
+            obs_memory.get_memory().annotate(span)
             span.__exit__(None, None, None)
             self.seconds[name] += time.perf_counter() - t0
             self.counts[name] += 1

@@ -98,6 +98,24 @@ def clean_tpu(d):
     return obs is None or lab is None or obs == lab
 
 
+def memory_row(d):
+    """One-line device-memory coverage summary of an artifact's "memory"
+    block (bench.py embeds predicted + measured peak bytes in every rung
+    JSON; obs/memory.py is the producer).  None when the artifact
+    predates the memory block."""
+    m = d.get("memory")
+    if not isinstance(m, dict):
+        return None
+    pred = m.get("predicted_peak_bytes", 0)
+    meas = m.get("measured_peak_bytes", 0)
+    ratio = m.get("measured_vs_predicted")
+    cap_b = m.get("device_capacity_bytes")
+    return (f"memory: predicted peak {pred / 1e9:.3f} GB, measured "
+            f"{meas / 1e9:.3f} GB ({m.get('measured_source')}"
+            f"{f', x{ratio} of model' if ratio is not None else ''}"
+            f"{f', capacity {cap_b / 1e9:.1f} GB' if cap_b else ''})")
+
+
 def main():
     cap = sys.argv[1]
     head = load(os.path.join(cap, "bench_1m.json"))
@@ -110,6 +128,9 @@ def main():
           f"{' DEGRADED' if 'degraded' in head else ''}"
           f"{f', observed kernel {obs}' if obs else ''}) "
           f"vs_baseline={head.get('vs_baseline')} link={head.get('link')}")
+    hm = memory_row(head)
+    if hm:
+        print(f"{'':10}{hm}")
     if not deciding:
         print("headline is not a clean TPU number -> NO flip decisions "
               "from this capture; table below is informational only")
@@ -131,6 +152,9 @@ def main():
                       f"({ls['leaves'][0]} vs {ls['leaves'][1]} leaves at "
                       f"{ls['rows']} rows; round-7 CPU pre/post was "
                       f"11.5 -> ~3.4)")
+            mr = memory_row(d)
+            if mr:
+                print(f"{'':53}{mr}")
     for fname, knob, action, base_name in FLIPS:
         d = load(os.path.join(cap, fname))
         if d is None:

@@ -16,6 +16,11 @@ pieces of evidence that localize it:
      ``leaves_sweep`` rung tracks per round.
   3. **loop-body jaxpr audit** (utils/jaxpr_audit.py): every op whose
      operand is O(N) or O(L·F·B) per step, the structural cause of 1-2.
+  4. **compiled-executable memory analysis** (obs/memory.py): the jitted
+     grower's and the binned-predict executable's argument/output/temp
+     bytes from ``compiled.memory_analysis()``, next to the analytic
+     ``predict_hbm`` transient model — the numbers the
+     tests/test_grow_jaxpr.py byte-budget ratchet pins at its own shape.
 
 Results land in the obs counter registry as gauges (so a surrounding
 telemetry trace embeds them) and as ONE json line on stdout.
@@ -167,6 +172,39 @@ def main():
     sys.stderr.write("loop-body ops with O(N) / O(L*F*B) operands:\n")
     for r in inventory:
         sys.stderr.write(f"  {r['prim']:24s} {r['shapes']}\n")
+
+    # ---- 4. compiled-executable memory analysis -----------------------
+    from lightgbm_tpu.obs import memory as obs_memory
+    grow_mem = obs_memory.analyze_jitted(make_grower(cfg_for(L)), *dev,
+                                         label="grow")
+    result["grow_memory"] = grow_mem
+    if grow_mem:
+        sys.stderr.write(
+            f"grow executable: args {grow_mem['argument_bytes'] / 1e6:.2f} "
+            f"MB, temp {grow_mem['temp_bytes'] / 1e6:.2f} MB, peak "
+            f"{grow_mem['peak_bytes'] / 1e6:.2f} MB\n")
+    from lightgbm_tpu.predictor import predict_binned_leaf
+    P = L - 1
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+    pred_mem = obs_memory.analyze_jitted(
+        predict_binned_leaf,           # nested jit collapses in lowering
+        jax.ShapeDtypeStruct((n, f), dev[0].dtype),
+        i32(P), i32(P), jax.ShapeDtypeStruct((P,), jnp.bool_),
+        i32(P), i32(P), i32(f, 5),
+        jax.ShapeDtypeStruct((P,), jnp.bool_),
+        jax.ShapeDtypeStruct((P, b), jnp.bool_),
+        label="predict")
+    result["predict_memory"] = pred_mem
+    if pred_mem:
+        sys.stderr.write(
+            f"predict executable: temp {pred_mem['temp_bytes'] / 1e6:.2f} "
+            f"MB, peak {pred_mem['peak_bytes'] / 1e6:.2f} MB\n")
+    model = obs_memory.predict_hbm(rows=n, features=f, bins=b, leaves=L)
+    result["predict_hbm"] = {"transient_bytes": model["transient_bytes"],
+                             "peak_bytes": model["peak_bytes"]}
+    sys.stderr.write(
+        f"analytic model: transients {model['transient_bytes'] / 1e6:.2f} "
+        f"MB, peak {model['peak_bytes'] / 1e6:.2f} MB\n")
 
     print(json.dumps(result))
 

@@ -31,6 +31,15 @@ No-Verification-Needed: measurement artifacts only" || true
     fi
 }
 
+memsnap() {
+    # one device memory_stats + live-census snapshot per bench rung
+    # (docs/MEMORY.md; the bench JSON itself embeds the in-child measured
+    # peak — this records the post-rung HBM occupancy the NEXT rung
+    # inherits, so a leak between rungs is attributable)
+    timeout 120 python -m lightgbm_tpu.obs.memory \
+        > "$OUT/memstats_$1.json" 2>> "$OUT/log.txt" || true
+}
+
 echo "== probe ==" | tee "$OUT/log.txt"
 if ! timeout 120 python -c "import jax; print(jax.devices())" \
         >> "$OUT/log.txt" 2>&1; then
@@ -64,9 +73,13 @@ BENCH_TREES=10 BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
 cat "$OUT/bench_1m.json" | tee -a "$OUT/log.txt"
 # per-phase/per-kernel telemetry report for the headline rung (the trace
 # file is written by the measured child; decide_flips reads the observed
-# kernel identity straight out of bench_1m.json's telemetry block)
+# kernel identity straight out of bench_1m.json's telemetry block), plus
+# the machine-readable --json twin for downstream tooling
 timeout 300 python -m lightgbm_tpu.obs "$OUT/trace_1m.jsonl" \
     > "$OUT/trace_1m.md" 2>> "$OUT/log.txt" || true
+timeout 300 python -m lightgbm_tpu.obs --json "$OUT/trace_1m.jsonl" \
+    > "$OUT/trace_1m.report.json" 2>> "$OUT/log.txt" || true
+memsnap "1m"
 echo "jax_cache entries: $(ls .jax_cache 2>/dev/null | wc -l)" \
     | tee -a "$OUT/log.txt"   # nonzero growth => TPU executables persist
 snap "headline bench"
@@ -89,6 +102,7 @@ BENCH_TRACE="$OUT/trace_1m_gen1.jsonl" \
 BENCH_TREES=6 BENCH_FUSED=0 BENCH_STAGE_TIMEOUT=1200 timeout 1500 \
     python bench.py > "$OUT/bench_1m_gen1.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_1m_gen1.json" | tee -a "$OUT/log.txt"
+memsnap "1m_gen1"
 snap "gen-1 forced A/B"
 
 alive_or_abort "gen-1 A/B"
@@ -101,6 +115,7 @@ BENCH_TRACE="$OUT/trace_leaves.jsonl" \
 BENCH_LEAVES_SWEEP=1 BENCH_TREES=4 BENCH_STAGE_TIMEOUT=1500 timeout 1800 \
     python bench.py > "$OUT/bench_leaves.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_leaves.json" | tee -a "$OUT/log.txt"
+memsnap "leaves"
 snap "leaves sweep"
 
 alive_or_abort "leaves sweep"
@@ -171,6 +186,7 @@ BENCH_ROWS=10500000 BENCH_TREES=3 BENCH_STAGE_TIMEOUT=2400 \
     timeout 2700 python bench.py \
     > "$OUT/bench_higgs_full.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_higgs_full.json" | tee -a "$OUT/log.txt"
+memsnap "higgs_full"
 snap "full Higgs 10.5M"
 
 alive_or_abort "full Higgs"
@@ -234,6 +250,7 @@ BENCH_ROWS=200000 BENCH_ROWS_CPU=200000 BENCH_FEATURES=2000 \
     BENCH_TREES=5 BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
     > "$OUT/bench_wide.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_wide.json" | tee -a "$OUT/log.txt"
+memsnap "wide"
 snap "wide bench"
 
 alive_or_abort "wide bench"
@@ -252,6 +269,7 @@ BENCH_ROWS=1000000 BENCH_ROWS_CPU=1000000 BENCH_SPARSITY=0.9 \
     BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
     > "$OUT/bench_sparse_nopack.json" 2>> "$OUT/log.txt"
 cat "$OUT/bench_sparse_nopack.json" | tee -a "$OUT/log.txt"
+memsnap "sparse"
 snap "sparse bench + packing A/B"
 
 alive_or_abort "sparse bench"

@@ -1,4 +1,5 @@
-"""bench.py dataset-cache key invariant (ADVICE r5 #4).
+"""bench.py contract invariants: dataset-cache keys (ADVICE r5 #4) and
+the memory block every rung JSON must embed.
 
 The bench memoizes constructed datasets on disk keyed by shape + the
 BINNING_KEYS subset of params.  A construction-relevant Config attribute
@@ -49,6 +50,40 @@ def test_binning_keys_superset_of_data_layer_reads():
         "that are neither in bench.BINNING_KEYS (construction-relevant -> "
         "must key the dataset cache) nor exempted in "
         "NON_CONSTRUCTION_READS (with a reason). Classify them.")
+
+
+def test_bench_child_embeds_memory_block():
+    """Every bench JSON must carry the "memory" block (predicted +
+    measured peak bytes, obs/memory.py) — acceptance criterion of the
+    memory-observability PR; on the CPU rung the measured source is the
+    live-array census and the ratio against the resident model must stay
+    inside the documented tolerance."""
+    import json
+    import subprocess
+    import sys
+    from lightgbm_tpu.obs.memory import RESIDENT_TOLERANCE
+    env = dict(os.environ, BENCH_CHILD="1", BENCH_CHILD_PLATFORM="cpu",
+               BENCH_CHILD_MODE="segment", BENCH_ROWS="5000",
+               BENCH_ROWS_CPU="5000", BENCH_TREES_CPU="1",
+               BENCH_LEAVES="15", BENCH_LEAVES_SWEEP="0", BENCH_DS_CACHE="",
+               BENCH_TRACE="", JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [ln for ln in r.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    mem = json.loads(line)["memory"]
+    for key in ("predicted_peak_bytes", "predicted_resident_bytes",
+                "measured_peak_bytes", "measured_source", "top_residents"):
+        assert key in mem, f"memory block missing {key}"
+    assert mem["measured_source"] == "live_census"
+    assert mem["measured_peak_bytes"] > 0
+    # tiny shapes carry proportionally more fixed overhead than the bench
+    # rungs, so allow twice the documented band here; the tight band is
+    # pinned at bench-like shapes in tests/test_memory.py
+    ratio = mem["measured_vs_predicted"]
+    assert ratio is not None and \
+        1 - 2 * RESIDENT_TOLERANCE <= ratio <= 1 + 2 * RESIDENT_TOLERANCE
 
 
 def test_binning_keys_are_real_config_fields():

@@ -85,3 +85,42 @@ def test_compiled_body_has_no_full_pool_copies():
     assert not copies, (
         f"{len(copies)} full hist_store copies in the compiled "
         f"executable — the per-split fixed cost regression is back")
+
+
+# ---- byte-budget ratchet (obs/memory.executable_memory) -------------------
+#
+# The zero-copy HLO pin above catches the exact regression XLA exhibited;
+# this pins the BUDGET CLASS: the compiled grower's temp bytes at this
+# shape, measured 2,673,800 on the jax-0.4.37 CPU backend.  The budget
+# below allows ~23% toolchain drift but NOT a copy-insertion regression —
+# one extra pair of full hist_store [15,8,64,3] clones alone is +737,280
+# temp bytes, which overshoots the remaining headroom.  If a jax upgrade
+# legitimately moves the number, re-measure and ratchet the constant (and
+# say so in the commit); never widen it past one pool-clone pair.
+
+TEMP_BYTES_BUDGET = 3_300_000
+TEMP_BYTES_FLOOR = 1_000_000    # sanity: hist_store alone is 368,640 —
+#                                 a near-zero reading means the analysis
+#                                 broke, not that memory got free
+
+
+def test_compiled_grower_temp_bytes_within_budget():
+    from lightgbm_tpu.obs.counters import counters
+    from lightgbm_tpu.obs.memory import executable_memory
+    grow, args = _grow_and_args()
+    compiled = jax.jit(grow).lower(*args).compile()
+    m = executable_memory(compiled, label="grow_pin")
+    assert m is not None, "memory_analysis unavailable on this backend"
+    # argument bytes track the real input payloads (small slack: XLA's
+    # bool/padding accounting differs from numpy nbytes by a few bytes)
+    nbytes = sum(int(np.asarray(a).nbytes)
+                 for a in jax.tree_util.tree_leaves(args))
+    assert abs(m["argument_bytes"] - nbytes) <= 64
+    assert TEMP_BYTES_FLOOR <= m["temp_bytes"] <= TEMP_BYTES_BUDGET, (
+        f"compiled grower temp bytes {m['temp_bytes']} left the recorded "
+        f"budget [{TEMP_BYTES_FLOOR}, {TEMP_BYTES_BUDGET}] — either a "
+        f"copy-insertion regression (see docstring) or a toolchain move "
+        f"that must be re-measured deliberately")
+    # the helper records the evidence as gauges for reports/benches
+    assert counters.snapshot()["gauges"]["exec_grow_pin_temp_bytes"] == \
+        m["temp_bytes"]

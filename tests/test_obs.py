@@ -218,6 +218,65 @@ def test_observed_kernel_matches_hist_method():
     assert counters.observed_kernel() == "einsum"
 
 
+def test_event_ring_buffer_is_bounded_with_overflow_counter():
+    """Satellite of the memory-observability PR: long trainings with
+    telemetry on must not grow host memory without bound — the event store
+    is a ring that counts what it drops instead of leaking."""
+    counters.reset()
+    cap = counters.MAX_EVENTS
+    for i in range(cap + 7):
+        counters.event("spam", i=i)
+    evs = counters.events("spam")
+    assert len(evs) == cap
+    assert evs[0]["i"] == 7 and evs[-1]["i"] == cap + 6   # oldest evicted
+    assert counters.events_dropped() == 7
+    snap = counters.snapshot()
+    assert snap["events_dropped"] == 7
+    counters.reset()
+    assert counters.events_dropped() == 0
+
+
+def test_events_and_spans_carry_process_index(tmp_path):
+    counters.reset()
+    counters.event("probe")
+    assert counters.events("probe")[0]["proc"] == 0    # single-process CPU
+    assert counters.snapshot()["process_index"] == 0
+    tr = obs_trace.Tracer(str(tmp_path / "t.json"))
+    with tr.span("a"):
+        pass
+    tr.instant("b")
+    assert all(e["proc"] == 0 for e in tr.events())
+
+
+def test_cli_merges_multiple_traces_rank_tagged(tmp_path):
+    """Satellite: the report CLI accepts several trace files (one per
+    process of a multi-host run) and merges them into ONE rank-tagged
+    report — the first concrete step on the ROADMAP multi-process
+    coordination item."""
+    paths = []
+    for rank in (0, 1):
+        p = str(tmp_path / f"r{rank}.jsonl")
+        tr = obs_trace.Tracer(p)
+        tr.proc = rank                   # what a rank-r process would stamp
+        with tr.span("iteration", index=0):
+            pass
+        tr.write()
+        paths.append(p)
+    text = obs_report.render(paths)
+    assert "[r0] iteration" in text and "[r1] iteration" in text
+    assert "rank 0" in text and "rank 1" in text
+    # the --json twin carries one entry per file with its rank
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.obs", "--json", *paths],
+        capture_output=True, text=True, cwd=ROOT, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PYTHONPATH=ROOT + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")))
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(r.stdout)
+    assert [f["rank"] for f in doc["files"]] == [0, 1]
+
+
 # ---------------------------------------------------------------- collectives
 
 
