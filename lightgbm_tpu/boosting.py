@@ -1057,6 +1057,18 @@ class GBDT:
 
     # ------------------------------------------------------------ checkpoint
 
+    def data_fingerprint(self) -> int:
+        """Identity of THIS process's dataset partition (shape + dtype + a
+        strided sample of the binned matrix).  Rides every checkpoint — and
+        the multi-process manifest — so a resume over different data (a
+        re-partitioned shard, changed binning) is a structured error
+        instead of silent divergence."""
+        from . import checkpoint as checkpoint_mod
+        ts = self.train_set
+        return checkpoint_mod.data_fingerprint(
+            None if ts is None else ts.binned,
+            0 if ts is None else ts.num_data)
+
     def checkpoint_state(self) -> dict:
         """Bit-exact resumable training state (lightgbm_tpu.checkpoint):
         everything ``train_one_iter`` reads that is not derivable from the
@@ -1064,6 +1076,7 @@ class GBDT:
         bagging subset/mask, and iteration bookkeeping."""
         self._drain_pending()
         st = {
+            "data_fingerprint": self.data_fingerprint(),
             "kind": self.sub_model_name,
             "models": list(self._models),
             "iter_": self.iter_,
@@ -1087,7 +1100,15 @@ class GBDT:
     def load_checkpoint_state(self, st: dict) -> None:
         """Inverse of :meth:`checkpoint_state`; requires a booster built
         on the same dataset/params (the checkpoint carries training state,
-        not the binned data)."""
+        not the binned data — the fingerprint check enforces exactly
+        that)."""
+        fp = st.get("data_fingerprint")
+        if fp is not None and int(fp) != self.data_fingerprint():
+            from .checkpoint import CheckpointError
+            raise CheckpointError(
+                "checkpoint dataset-partition fingerprint does not match "
+                "the training data this booster holds — resuming would "
+                "silently diverge (did the row shard or binning change?)")
         self._pending = []
         self._models = list(st["models"])
         self.iter_ = int(st["iter_"])

@@ -25,12 +25,36 @@ with a real checkpoint:
   — so a resumed run's final model is byte-identical to an uninterrupted
   one (pinned by ``tests/test_robustness.py``).
 * **Retention** — :func:`prune_snapshots` keeps the ``snapshot_keep``
-  most-recent snapshots.
+  most-recent snapshots; a multi-process snapshot *set* (shards + manifest)
+  is pruned as a unit, manifest first, so a reader can never observe a
+  half-deleted set as valid.
 
-The ``torn_checkpoint`` injection point (:mod:`lightgbm_tpu.utils.faults`)
-writes a half file at the final path and raises
+**Multi-process (coordinated) checkpoints** — with ``process_count > 1``
+each rank owns a score partition no other rank can reconstruct
+("Block-distributed GBT" state shape), so one file cannot checkpoint the
+group.  The protocol (docs/ROBUSTNESS.md):
+
+1. every rank atomically writes ``<output_model>.snapshot_iter_N.rank_R``
+   (ordinary model text on rank 0 only; the state blob — that rank's score
+   partitions, RNG positions, bagging state — everywhere), then
+2. a barrier (an allgather of per-shard CRC32s through the hardened
+   :mod:`lightgbm_tpu.parallel.sync` ladder), then
+3. rank 0 writes ``<output_model>.snapshot_iter_N.manifest`` — the **commit
+   point** — carrying per-shard CRC32s, ``process_count``, and each rank's
+   dataset-partition fingerprint.
+
+A set without a manifest never existed; a torn shard on any rank demotes
+the whole group to the previous good set (:func:`find_latest_valid_group`
+allgathers per-rank valid iterations and agrees on the max everywhere-valid
+one); a manifest whose ``process_count`` or partition fingerprint does not
+match the resuming job is a structured :class:`CheckpointError`, never
+silent divergence.
+
+The ``torn_checkpoint`` / ``torn_shard_rank`` / ``torn_manifest`` /
+``rank_crash_in_barrier`` injection points (:mod:`lightgbm_tpu.utils.faults`)
+leave a half file at the final path and/or raise
 :class:`~lightgbm_tpu.utils.faults.SimulatedCrash`, standing in for
-SIGKILL inside the legacy non-atomic write window.
+SIGKILL at every distinct instant of the protocol.
 """
 from __future__ import annotations
 
@@ -40,6 +64,8 @@ import glob
 import os
 import pickle
 import re
+import signal
+import threading
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -50,6 +76,8 @@ CHECKPOINT_VERSION = 1
 _STATE_PREFIX = "checkpoint:v1:"
 _CRC_PREFIX = "checkpoint_crc32="
 _SNAP_RE = re.compile(r"\.snapshot_iter_(\d+)$")
+_SHARD_RE = re.compile(r"\.snapshot_iter_(\d+)\.rank_(\d+)$")
+_MANIFEST_RE = re.compile(r"\.snapshot_iter_(\d+)\.manifest$")
 
 
 class CheckpointError(RuntimeError):
@@ -104,11 +132,23 @@ def decode(data: bytes) -> Tuple[str, Dict[str, Any]]:
     return model_str, state
 
 
+def _process_index() -> int:
+    """This process's distributed rank (0 when the runtime is not up).
+    Part of the tmp-file key: on a shared filesystem two HOSTS can hold the
+    same pid, so a pid-only tmp name collides across ranks."""
+    try:
+        from .parallel.sync import process_index
+        return process_index()
+    except Exception:        # pragma: no cover - jax import/backend issues
+        return 0
+
+
 def write_atomic(path: str, data: bytes) -> None:
     """tmp + fsync + ``os.replace``: all-or-nothing at the final path."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
-    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    tmp = os.path.join(
+        d, f".{os.path.basename(path)}.tmp.r{_process_index()}.{os.getpid()}")
     try:
         with open(tmp, "wb") as f:
             f.write(data)
@@ -118,6 +158,66 @@ def write_atomic(path: str, data: bytes) -> None:
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+# ------------------------------------------------------------- preemption
+
+class PreemptionWatch:
+    """Preemption safety (``preempt_signal`` param): turns SIGTERM/SIGINT
+    into "write a coordinated checkpoint at the next iteration boundary
+    and exit the training loop cleanly" instead of dying wherever the
+    signal lands.  The handler only flips :attr:`requested`; all actual
+    work happens at the loop boundary where the training state is
+    consistent.  ``install``/``restore`` scope the handlers to one
+    ``train()`` call."""
+
+    def __init__(self, spec: str):
+        self.spec = str(spec or "")
+        self.requested = False
+        self.armed = False
+        self._installed: List[Tuple[int, Any]] = []
+
+    def _signals(self) -> List[int]:
+        sigs = []
+        for tok in self.spec.replace(",", " ").split():
+            t = tok.strip().lower()
+            if t in ("sigterm", "term"):
+                sigs.append(signal.SIGTERM)
+            elif t in ("sigint", "int"):
+                sigs.append(signal.SIGINT)
+        return sigs
+
+    def _on_signal(self, signum, frame) -> None:
+        self.requested = True
+
+    def install(self) -> "PreemptionWatch":
+        if not self.spec:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            # signal.signal() is a main-thread-only API; say so instead of
+            # dying — the deterministic `preempt` fault point still works
+            log.warning("preempt_signal: handlers can only be installed "
+                        "from the main thread; preemption checkpointing "
+                        "is disabled for this training")
+            return self
+        for s in self._signals():
+            self._installed.append((s, signal.signal(s, self._on_signal)))
+        self.armed = bool(self._installed)
+        return self
+
+    def restore(self) -> None:
+        for s, old in self._installed:
+            signal.signal(s, old)
+        self._installed = []
+        self.armed = False
+
+
+def iteration_from_path(path: str) -> Optional[int]:
+    """The ``N`` of any ``*.snapshot_iter_N[...]`` file name (plain
+    snapshot, rank shard, or manifest); None when the name carries no
+    iteration."""
+    m = re.search(r"\.snapshot_iter_(\d+)", str(path))
+    return int(m.group(1)) if m else None
 
 
 # ------------------------------------------------------------ capture/restore
@@ -202,15 +302,25 @@ def list_snapshots(output_model: str) -> List[Tuple[int, str]]:
     return sorted(out)
 
 
+def _skip_event(iteration: int, path: str, reason: str) -> None:
+    """Structured twin of every snapshot-skip warning (the PR 5
+    ``layout_downgrade`` discipline): the obs event stream — not just
+    stderr — carries why a resume did not use a snapshot."""
+    from .obs.counters import counters
+    counters.event("checkpoint_skipped", iteration=int(iteration),
+                   path=path, reason=reason)
+
+
 def find_latest_valid(output_model: str):
     """Newest *valid* snapshot for this model prefix, as
     ``(iteration, path, state)``; invalid (torn) files are skipped with a
-    warning — the previous good snapshot wins.  None when nothing valid
-    exists."""
+    warning + a ``checkpoint_skipped`` obs event — the previous good
+    snapshot wins.  None when nothing valid exists."""
     for it, path in reversed(list_snapshots(output_model)):
         try:
             _, state = load_snapshot(path)
         except CheckpointError as e:
+            _skip_event(it, path, str(e))
             log.warning("Skipping invalid snapshot %s: %s", path, e)
             continue
         return it, path, state
@@ -219,12 +329,237 @@ def find_latest_valid(output_model: str):
 
 def prune_snapshots(output_model: str, keep: int) -> None:
     """Keep the ``keep`` highest-iteration snapshots; remove the rest
-    (``keep <= 0`` keeps everything)."""
+    (``keep <= 0`` keeps everything).
+
+    Shard/manifest-aware: a multi-process snapshot *set* counts as one
+    snapshot and is removed as a unit — manifest (the commit point) FIRST,
+    so at no instant does a partially deleted set still look committed,
+    and no orphan rank shards are ever stranded behind."""
     if keep <= 0:
         return
-    snaps = list_snapshots(output_model)
-    for _, path in snaps[:-keep]:
+    iters = sorted(set(it for it, _ in list_snapshots(output_model))
+                   | set(list_snapshot_sets(output_model)))
+    for it in iters[:-keep]:
+        sets = list_snapshot_sets(output_model)
+        paths = []
+        if it in sets:
+            man, shards = sets[it]
+            paths = ([man] if man else []) + [p for _, p in sorted(shards)]
+        plain = snapshot_path(output_model, it)
+        if os.path.exists(plain):
+            paths.append(plain)
+        for path in paths:
+            try:
+                os.unlink(path)
+            except OSError as e:  # pragma: no cover - races with external rm
+                log.debug("snapshot prune: could not remove %s (%s)",
+                          path, e)
+
+
+# ------------------------------------- multi-process coordinated snapshots
+
+def shard_path(output_model: str, iteration: int, rank: int) -> str:
+    return f"{output_model}.snapshot_iter_{iteration}.rank_{rank}"
+
+
+def manifest_path(output_model: str, iteration: int) -> str:
+    return f"{output_model}.snapshot_iter_{iteration}.manifest"
+
+
+def list_snapshot_sets(output_model: str) -> Dict[int, tuple]:
+    """Multi-process snapshot sets for this model prefix:
+    ``{iteration: (manifest_path_or_None, [(rank, shard_path), ...])}``.
+    A set with no manifest was never committed."""
+    sets: Dict[int, tuple] = {}
+    for p in glob.glob(glob.escape(output_model) + ".snapshot_iter_*"):
+        m = _SHARD_RE.search(p)
+        if m:
+            it = int(m.group(1))
+            sets.setdefault(it, (None, []))
+            sets[it][1].append((int(m.group(2)), p))
+            continue
+        m = _MANIFEST_RE.search(p)
+        if m:
+            it = int(m.group(1))
+            old = sets.get(it, (None, []))
+            sets[it] = (p, old[1])
+    return sets
+
+
+def data_fingerprint(binned, num_data: int) -> int:
+    """Cheap stable identity of THIS rank's dataset partition: shape,
+    dtype, and a strided row sample of the binned matrix.  Rides the
+    manifest so a resume onto re-partitioned data (different row shard,
+    different binning) is a structured error, not silent divergence."""
+    import numpy as np
+    crc = zlib.crc32(f"{num_data}".encode())
+    if binned is not None:
+        a = np.ascontiguousarray(binned)
+        crc = zlib.crc32(f"{a.shape}:{a.dtype}".encode(), crc)
+        step = max(1, a.shape[0] // 4096) if a.ndim else 1
+        crc = zlib.crc32(np.ascontiguousarray(a[::step]).tobytes(), crc)
+    return crc
+
+
+def _default_gather():
+    from .parallel.sync import allgather_object
+    return allgather_object
+
+
+def write_group_snapshot(output_model: str, iteration: int, model_str: str,
+                         state: Dict[str, Any], *, rank: int, world: int,
+                         fingerprint: int, gather=None) -> None:
+    """One rank's half of the coordinated snapshot protocol.
+
+    Shard write (atomic, every rank) -> barrier (allgather of shard CRCs
+    through the hardened collective ladder) -> manifest write (rank 0, the
+    commit point).  A crash at ANY instant leaves either the previous
+    committed set or the new one: shards without a manifest never existed.
+    """
+    gather = gather or _default_gather()
+    fi = faults_mod.get_faults()
+    spath = shard_path(output_model, iteration, rank)
+    data = encode(model_str, state)
+    if fi.enabled and fi.fire("torn_shard_rank", iteration):
+        # SIGKILL mid-shard-write on this rank: torn file at the FINAL
+        # path; peers block in the barrier until the collective timeout
+        with open(spath, "wb") as f:
+            f.write(data[:max(1, len(data) // 2)])
+        raise faults_mod.SimulatedCrash(
+            f"torn_shard_rank fault: rank {rank} killed writing {spath}")
+    write_atomic(spath, data)
+    if fi.enabled and fi.fire("rank_crash_in_barrier", iteration):
+        raise faults_mod.SimulatedCrash(
+            f"rank_crash_in_barrier fault: rank {rank} killed before the "
+            f"iteration-{iteration} snapshot barrier")
+    # barrier + CRC exchange: nobody commits until every shard is durable
+    infos = gather({"rank": rank, "crc": zlib.crc32(data),
+                    "fingerprint": int(fingerprint)})
+    if rank != 0:
+        return
+    by_rank = {int(i["rank"]): i for i in infos}
+    manifest = {
+        "version": CHECKPOINT_VERSION,
+        "iteration": int(iteration),
+        "process_count": int(world),
+        "shard_crc32": [int(by_rank[r]["crc"]) for r in range(world)],
+        "data_fingerprint": [int(by_rank[r]["fingerprint"])
+                             for r in range(world)],
+    }
+    mdata = encode("", manifest)
+    mpath = manifest_path(output_model, iteration)
+    if fi.enabled and fi.fire("torn_manifest", iteration):
+        with open(mpath, "wb") as f:
+            f.write(mdata[:max(1, len(mdata) // 2)])
+        raise faults_mod.SimulatedCrash(
+            f"torn_manifest fault: rank 0 killed writing {mpath}")
+    write_atomic(mpath, mdata)
+
+
+def load_manifest(output_model: str, iteration: int) -> Dict[str, Any]:
+    """Read + validate one committed manifest; :class:`CheckpointError` on
+    a torn/garbled file."""
+    _, manifest = load_snapshot(manifest_path(output_model, iteration))
+    return manifest
+
+
+def _local_valid_group_iters(output_model: str, rank: int, world: int,
+                             fingerprint: int):
+    """Scan committed sets newest-first from THIS rank's point of view.
+
+    Returns ``(ok_iters, fatal)``: iterations whose manifest AND this
+    rank's shard validate (descending), plus a structured-mismatch message
+    (topology / partition fingerprint) that must fail the whole group —
+    reported through the gather so every rank raises the same error
+    instead of one rank dying while its peers wait in the barrier."""
+    ok: List[int] = []
+    fatal: Optional[str] = None
+    for it in sorted(list_snapshot_sets(output_model), reverse=True):
         try:
-            os.unlink(path)
-        except OSError as e:   # pragma: no cover - races with external rm
-            log.debug("snapshot prune: could not remove %s (%s)", path, e)
+            manifest = load_manifest(output_model, it)
+        except CheckpointError as e:
+            # torn/uncommitted manifest: the set never existed — demote
+            _skip_event(it, manifest_path(output_model, it), str(e))
+            log.warning("Skipping snapshot set iter %d: %s", it, e)
+            continue
+        if int(manifest.get("process_count", -1)) != world:
+            fatal = (f"checkpoint set at iteration {it} was written by "
+                     f"{manifest.get('process_count')} process(es) but this "
+                     f"job runs {world} — resuming across a topology change "
+                     "would silently diverge; restart from scratch or rerun "
+                     "with the original process count")
+            break
+        if int(manifest["data_fingerprint"][rank]) != int(fingerprint):
+            fatal = (f"checkpoint set at iteration {it}: rank {rank}'s "
+                     "dataset-partition fingerprint does not match the "
+                     "manifest — the data shard this rank holds is not the "
+                     "one the checkpoint was taken over")
+            break
+        spath = shard_path(output_model, it, rank)
+        try:
+            with open(spath, "rb") as f:
+                data = f.read()
+            got = zlib.crc32(data)
+            want = int(manifest["shard_crc32"][rank])
+            if got != want:
+                raise CheckpointError(
+                    f"shard CRC mismatch vs manifest (manifest {want:08x}, "
+                    f"file {got:08x})")
+            decode(data)     # torn-tail/garble check on the shard itself
+        except (OSError, CheckpointError) as e:
+            _skip_event(it, spath, f"rank {rank}: {e}")
+            log.warning("Snapshot set iter %d invalid on rank %d (%s); "
+                        "demoting the group to an older set", it, rank, e)
+            continue
+        ok.append(it)
+    return ok, fatal
+
+
+def find_latest_valid_group(output_model: str, *, rank: int, world: int,
+                            fingerprint: int, gather=None,
+                            only_iteration: Optional[int] = None):
+    """The resume barrier: every rank scans its own shards, the ranks
+    allgather their locally-valid iteration lists, and the group agrees on
+    the newest iteration valid on EVERY rank (a torn shard on any rank
+    demotes all of them — mirroring the single-process torn-tail
+    fallback).  Returns ``(iteration, shard_path, state)`` for this rank,
+    or None when no set is valid everywhere.
+
+    ``only_iteration`` pins resume to one explicit set: anything less than
+    group-wide validity of exactly that set raises."""
+    gather = gather or _default_gather()
+    ok, fatal = _local_valid_group_iters(output_model, rank, world,
+                                         fingerprint)
+    views = gather({"rank": rank, "ok": ok, "fatal": fatal})
+    if only_iteration is not None:
+        # pin applied to EVERY view after the gather, so the agreement is
+        # on exactly that set no matter what each rank was asked locally
+        keep = int(only_iteration)
+        ok = [it for it in ok if it == keep]
+        views = [dict(v, ok=[i2 for i2 in v["ok"] if i2 == keep])
+                 for v in views]
+    for v in sorted(views, key=lambda v: int(v["rank"])):
+        if v["fatal"]:
+            raise CheckpointError(f"rank {v['rank']}: {v['fatal']}")
+    agreed = set.intersection(*[set(v["ok"]) for v in views]) \
+        if views else set()
+    local_best = max(ok, default=None)
+    if not agreed:
+        if only_iteration is not None:
+            raise CheckpointError(
+                f"snapshot set at iteration {only_iteration} of "
+                f"{output_model} is not valid on every rank")
+        return None
+    best = max(agreed)
+    if local_best is not None and best != local_best:
+        # visible demotion: this rank had a newer set, but a peer's torn
+        # shard drags the whole group back to the last everywhere-good one
+        bad_ranks = [int(v["rank"]) for v in views
+                     if local_best not in v["ok"]]
+        _skip_event(local_best, shard_path(output_model, local_best, rank),
+                    f"demoted to iteration {best}: rank(s) {bad_ranks} "
+                    "hold no valid shard")
+        log.warning("Snapshot set iter %d demoted to iter %d (invalid on "
+                    "rank(s) %s)", local_best, best, bad_ranks)
+    _, state = load_snapshot(shard_path(output_model, best, rank))
+    return best, shard_path(output_model, best, rank), state

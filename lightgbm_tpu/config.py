@@ -242,6 +242,18 @@ class Config:
                                      # e.g. nan_grad@3,torn_checkpoint@4,
                                      # collective_fail_once (utils/faults.py;
                                      # also via LGBM_TPU_FAULT_INJECT env)
+    preempt_signal: str = ""         # preemption safety: signals that
+                                     # request a coordinated checkpoint at
+                                     # the next iteration boundary and a
+                                     # clean training exit — "sigterm",
+                                     # "sigint", or "sigterm,sigint"
+                                     # ("" = off).  Multi-process ranks
+                                     # agree on the request through the
+                                     # hardened collective ladder (one
+                                     # small allgather per iteration while
+                                     # armed); snapshots land at
+                                     # output_model like snapshot_freq ones
+                                     # and resume with snapshot_resume.
 
     # distributed (reference NetworkConfig -> JAX mesh knobs)
     num_machines: int = 1
@@ -473,6 +485,12 @@ def check_param_conflicts(cfg: Config) -> None:
             parse_spec(cfg.fault_inject)
         except ValueError as e:
             log.fatal("%s", e)
+    if cfg.preempt_signal:
+        for tok in str(cfg.preempt_signal).replace(",", " ").split():
+            if tok.strip().lower() not in ("sigterm", "sigint", "term",
+                                           "int"):
+                log.fatal("preempt_signal must name sigterm and/or sigint "
+                          "(comma-separated); got %r", cfg.preempt_signal)
     if cfg.hbm_budget < 0:
         log.fatal("hbm_budget must be >= 0 bytes (0 = warn-only pre-flight "
                   "against the detected device capacity); got %r",

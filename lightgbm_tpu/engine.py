@@ -146,8 +146,34 @@ def train(params: Dict[str, Any], train_set: Dataset,
     snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
     snapshot_keep = int(params.get("snapshot_keep", -1) or -1)
     snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
-    single_process = sync_mod.process_count() == 1
+    world = sync_mod.process_count()
+    rank = sync_mod.process_index()
+    single_process = world == 1
     ckpt_callbacks = cbs_before + cbs_after   # stable capture/restore order
+
+    def _write_checkpoint(iteration: int) -> None:
+        """One atomic snapshot at an iteration boundary: the single-file
+        checkpoint when alone, the coordinated shard-set protocol (shards
+        -> CRC barrier -> rank-0 manifest commit) across processes."""
+        if single_process:
+            checkpoint_mod.write_snapshot(
+                checkpoint_mod.snapshot_path(snapshot_out, iteration),
+                booster, iteration, ckpt_callbacks, evals_result)
+            if snapshot_keep > 0:
+                checkpoint_mod.prune_snapshots(snapshot_out, snapshot_keep)
+            return
+        state = checkpoint_mod.capture_state(booster, iteration,
+                                             ckpt_callbacks, evals_result)
+        checkpoint_mod.write_group_snapshot(
+            snapshot_out, iteration,
+            booster.model_to_string(-1) if rank == 0 else "", state,
+            rank=rank, world=world,
+            fingerprint=booster.inner.data_fingerprint())
+        if snapshot_keep > 0 and rank == 0:
+            # only after the manifest commit, and only on rank 0: the
+            # barrier guarantees every shard of the new set is durable, so
+            # pruning can never race a peer's in-flight write
+            checkpoint_mod.prune_snapshots(snapshot_out, snapshot_keep)
 
     # ---- resume from the latest valid snapshot (docs/ROBUSTNESS.md) ----
     if resume is None:
@@ -160,25 +186,34 @@ def train(params: Dict[str, Any], train_set: Dataset,
             resume = True
     start_iter = 0
     if resume:
-        if not single_process:
-            log.warning("snapshot_resume is single-process for now; "
-                        "ignoring (multi-process checkpoint coordination "
-                        "is a ROADMAP item)")
-        else:
+        if single_process:
             if isinstance(resume, str):    # explicit checkpoint file
                 _, state = checkpoint_mod.load_snapshot(resume)
                 found = (int(state["iteration"]), resume, state)
             else:                          # auto-detect; torn tails skipped
                 found = checkpoint_mod.find_latest_valid(snapshot_out)
-            if found is None:
-                log.info("snapshot_resume: no valid snapshot for %s; "
-                         "training from scratch", snapshot_out)
-            else:
-                _, ck_path, state = found
-                start_iter = checkpoint_mod.restore_state(
-                    booster, state, ckpt_callbacks, evals_result)
-                log.info("Resumed training from %s (continuing at "
-                         "iteration %d)", ck_path, start_iter)
+        else:
+            # the resume barrier: ranks agree on the newest set valid on
+            # EVERY rank (a torn shard anywhere demotes the whole group);
+            # topology/partition mismatches raise a CheckpointError on all
+            # ranks together instead of hanging the fleet
+            found = checkpoint_mod.find_latest_valid_group(
+                snapshot_out, rank=rank, world=world,
+                fingerprint=booster.inner.data_fingerprint(),
+                only_iteration=(checkpoint_mod.iteration_from_path(resume)
+                                if isinstance(resume, str) else None))
+        if found is None:
+            log.info("snapshot_resume: no valid snapshot for %s; "
+                     "training from scratch", snapshot_out)
+        else:
+            _, ck_path, state = found
+            start_iter = checkpoint_mod.restore_state(
+                booster, state, ckpt_callbacks, evals_result)
+            obs_counters.event(
+                "checkpoint_resume", iteration=start_iter, path=ck_path,
+                kind="single" if single_process else "group")
+            log.info("Resumed training from %s (continuing at "
+                     "iteration %d)", ck_path, start_iter)
 
     # jax.profiler trace of the boosting loop (the reference's TIMETAG deep
     # profile becomes an xprof trace; lightweight counters are always on)
@@ -188,6 +223,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if profile_dir:
         import jax
         profile_ctx = jax.profiler.trace(str(profile_dir))
+
+    # preemption safety (docs/ROBUSTNESS.md): SIGTERM/SIGINT request a
+    # coordinated checkpoint at the next iteration boundary + a clean
+    # exit.  Installed HERE, immediately before the try whose finally
+    # restores the previous handlers, so they can never leak.
+    preempt_watch = checkpoint_mod.PreemptionWatch(
+        str(params.get("preempt_signal", "") or "")).install()
+    preempt_armed = preempt_watch.armed or \
+        faults_mod.get_faults().has_point("preempt")
 
     train_span = obs_trace.get_tracer().span(
         "train", num_boost_round=num_boost_round)
@@ -221,19 +265,35 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         booster.best_score.setdefault(
                             item[0], {})[item[1]] = item[2]
                     break
-                if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0 \
-                        and single_process:
+                wrote_snapshot = False
+                if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
                     # gbdt.cpp:456-460's snapshot cadence, upgraded to an
-                    # atomic resumable checkpoint: model text (still a valid
-                    # model file) + full training state + CRC footer,
-                    # written tmp+fsync+os.replace.  AFTER the callbacks so
-                    # the captured eval/early-stop state matches iteration i.
-                    checkpoint_mod.write_snapshot(
-                        checkpoint_mod.snapshot_path(snapshot_out, i + 1),
-                        booster, i + 1, ckpt_callbacks, evals_result)
-                    if snapshot_keep > 0:
-                        checkpoint_mod.prune_snapshots(snapshot_out,
-                                                       snapshot_keep)
+                    # atomic resumable checkpoint (coordinated shard set
+                    # across processes).  AFTER the callbacks so the
+                    # captured eval/early-stop state matches iteration i.
+                    _write_checkpoint(i + 1)
+                    wrote_snapshot = True
+                if preempt_armed:
+                    fi = faults_mod.get_faults()
+                    want = preempt_watch.requested or \
+                        (fi.enabled and fi.fire("preempt", i + 1))
+                    if not single_process:
+                        # a preemption notice may land on ONE rank only;
+                        # the group must agree before anyone checkpoints
+                        # or exits (hardened ladder: a dead peer surfaces
+                        # as a named CollectiveError, not a hang)
+                        want = any(sync_mod.allgather_object(bool(want)))
+                    if want:
+                        if not wrote_snapshot:
+                            _write_checkpoint(i + 1)
+                        obs_counters.event("preempt_checkpoint",
+                                           iteration=i + 1)
+                        log.info("Preemption requested: coordinated "
+                                 "checkpoint written at iteration %d; "
+                                 "exiting the training loop cleanly "
+                                 "(snapshot_resume continues from here)",
+                                 i + 1)
+                        break
                 if finished:
                     break
         # drain pipelined tree materialization NOW: deferred guard trips
@@ -244,6 +304,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             booster.best_iteration = booster.current_iteration()
         booster.inner.timers.report("training phase timers")
     finally:
+        preempt_watch.restore()   # handlers are scoped to THIS training
         if telemetry_on:
             # recompile evidence: how many distinct (shape, donation)
             # entries the grower jit accumulated this training — a number

@@ -15,7 +15,13 @@ users:
   nibble width gate, gather_words/panel gating) also record a
   ``layout_downgrade`` event with the machine-readable reason;
 * **collective accounting** — ``obs/collectives.py`` feeds
-  ``collective_calls`` / ``collective_bytes`` tagged by op + site.
+  ``collective_calls`` / ``collective_bytes`` tagged by op + site;
+* **checkpoint lifecycle events** — the resume paths
+  (:mod:`lightgbm_tpu.checkpoint`) record ``checkpoint_skipped``
+  (iteration + reason for every torn/demoted snapshot the scan rejected),
+  ``checkpoint_resume`` (iteration + ``kind=single|group``), and
+  ``preempt_checkpoint`` (clean preemption exits) — so a resumed run's
+  telemetry explains exactly which snapshot it continued from and why.
 
 Counts recorded from inside jit tracing are TRACE-time counts (once per
 compiled call site), which is exactly the "per call site" identity the

@@ -20,6 +20,22 @@ DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
 
 
+def distributed_is_initialized() -> bool:
+    """Is the multi-process runtime up?  ``jax.distributed.is_initialized``
+    is not present on every jax this repo supports (0.4.37 dropped it from
+    the public module), so fall back to the distributed global state the
+    way the ops/pallas_compat.py shim handles renamed Pallas API."""
+    try:
+        return bool(jax.distributed.is_initialized())
+    except AttributeError:
+        pass
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:       # pragma: no cover - future-jax defensive
+        return False
+
+
 def make_mesh(num_devices: int = 0, axis_name: str = DATA_AXIS,
               devices: Optional[Sequence] = None) -> Mesh:
     """1-D mesh over the given axis (rows for data-parallel, columns for
@@ -38,12 +54,32 @@ def make_2d_mesh(data: int, feature: int) -> Mesh:
     return Mesh(devs, (DATA_AXIS, FEATURE_AXIS))
 
 
+def _enable_cpu_collectives() -> None:
+    """Multi-process CPU needs a cross-process collectives transport: jax
+    0.4.37's default (``none``) makes every cross-host computation fail
+    with "Multiprocess computations aren't implemented on the CPU
+    backend".  Select gloo — but only when the job explicitly runs on CPU
+    (the 2-process CI harness); TPU slices keep their ICI transport."""
+    import os
+    plats = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" not in str(plats):
+        return
+    try:
+        # flag-only option: no attribute access, go through the value table
+        cur = jax.config.values.get("jax_cpu_collectives_implementation")
+        if cur in (None, "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, KeyError):  # pragma: no cover - older/newer jax
+        pass
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:
     """Multi-host bring-up (Network::Init analogue; machine-list file →
     coordinator address)."""
     if coordinator_address is not None:
+        _enable_cpu_collectives()
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
@@ -97,7 +133,7 @@ def init_distributed_from_config(cfg) -> bool:
         return False
     # must not touch the backend (jax.devices/process_count) before
     # jax.distributed.initialize; use is_initialized to test idempotently
-    if jax.distributed.is_initialized():
+    if distributed_is_initialized():
         return True                      # already initialized
     if not cfg.machine_list_file:
         log.fatal("num_machines=%d but no machine_list_file given",

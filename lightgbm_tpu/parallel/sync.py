@@ -26,6 +26,12 @@ every host-object collective here is wrapped in the same recovery ladder:
 process 0 pickles and ships its object (it used to run a full allgather
 and take element 0 — every process pickled and shipped a payload that was
 thrown away).
+
+The coordinated-checkpoint protocol (:mod:`lightgbm_tpu.checkpoint`) rides
+``allgather_object`` for both of its rendezvous — the shard-CRC commit
+barrier and the resume agreement — so a rank that dies mid-snapshot
+surfaces as a named ``CollectiveError`` after ``collective_timeout``
+seconds on its peers, never a silent fleet hang.
 """
 from __future__ import annotations
 
@@ -62,14 +68,30 @@ def configure(timeout: Optional[float] = None,
 
 def process_count() -> int:
     """Number of participating processes; 1 when the distributed runtime is
-    not initialized (safe to call before backend init)."""
+    not initialized (safe to call before backend init).
+
+    Goes through the mesh.py ``distributed_is_initialized`` compat shim:
+    the bare ``jax.distributed.is_initialized`` probe this used to do
+    raises AttributeError on jax 0.4.37 — which the old ``except`` turned
+    into a silent, WRONG "1 process" answer inside real multi-process
+    runs."""
     import jax
-    try:
-        if not jax.distributed.is_initialized():
-            return 1
-    except Exception:
+
+    from .mesh import distributed_is_initialized
+    if not distributed_is_initialized():
         return 1
     return jax.process_count()
+
+
+def process_index() -> int:
+    """This process's rank; 0 when the distributed runtime is not
+    initialized (the single-process identity)."""
+    import jax
+
+    from .mesh import distributed_is_initialized
+    if not distributed_is_initialized():
+        return 0
+    return jax.process_index()
 
 
 def _with_timeout(fn: Callable[[], Any], timeout: float, what: str) -> Any:
@@ -169,10 +191,15 @@ def allgather_object(obj: Any) -> List[Any]:
             for i in range(len(lens)):
                 blob = gathered[i, :int(lens[i])]
                 crc = zlib.crc32(np.ascontiguousarray(blob))
-                if crc != int(headers[i, 1]):
+                # compare in uint32 space: the gloo CPU transport returns
+                # int64 headers sign-truncated to 32 bits, so a crc with
+                # the top bit set comes back negative while still carrying
+                # the full 32 bits of integrity
+                want = int(headers[i, 1]) & 0xFFFFFFFF
+                if crc != want:
                     raise CollectiveError(
                         f"allgather_object payload from process {i} failed "
-                        f"its CRC check (sent {int(headers[i, 1]):08x}, "
+                        f"its CRC check (sent {want:08x}, "
                         f"received {crc:08x}) — corrupt or torn transfer")
                 out.append(pickle.loads(blob.tobytes()))
             return out
@@ -206,10 +233,13 @@ def broadcast_object(obj: Any = None) -> Any:
 
         def bcast() -> Any:
             hdr = np.asarray(multihost_utils.broadcast_one_to_all(header))
-            n, want = int(hdr[0]), int(hdr[1])
+            # uint32-space compare: gloo sign-truncates int64 headers
+            n, want = int(hdr[0]), int(hdr[1]) & 0xFFFFFFFF
             buf = payload if is_root else np.zeros(n, np.uint8)
+            # broadcast_one_to_all's internal psum promotes u8 to u32;
+            # restore the byte view or the CRC runs over 4x the bytes
             got = _maybe_corrupt(np.asarray(
-                multihost_utils.broadcast_one_to_all(buf)))
+                multihost_utils.broadcast_one_to_all(buf), dtype=np.uint8))
             crc = zlib.crc32(np.ascontiguousarray(got[:n]))
             if crc != want:
                 raise CollectiveError(

@@ -31,6 +31,16 @@ fails fast instead of silently injecting nothing):
 ``collective_corrupt``  received collective payload is bit-flipped so the
                      CRC integrity check must catch it
 ``hist_fail``        histogram dispatch raises :class:`InjectedFault`
+``preempt``          a preemption notice (SIGTERM) "arrives": training
+                     writes a coordinated checkpoint at the next iteration
+                     boundary and exits cleanly
+``torn_shard_rank``  multi-process snapshot: this rank's shard write dies
+                     halfway (torn file at the final path +
+                     :class:`SimulatedCrash`); peers hit the barrier timeout
+``torn_manifest``    rank 0 dies mid-manifest-write — the set is never
+                     committed and resume demotes to the previous good set
+``rank_crash_in_barrier``  this rank dies after its shard write but before
+                     the commit barrier
 ===================  ========================================================
 
 Mirrors the :mod:`lightgbm_tpu.obs.trace` singleton discipline: when no
@@ -45,7 +55,8 @@ import threading
 from typing import List, Optional
 
 KNOWN_POINTS = ("torn_checkpoint", "nan_grad", "inf_hess", "collective_fail",
-                "collective_corrupt", "hist_fail")
+                "collective_corrupt", "hist_fail", "preempt",
+                "torn_shard_rank", "torn_manifest", "rank_crash_in_barrier")
 
 
 class InjectedFault(RuntimeError):
@@ -120,6 +131,13 @@ class FaultPlan:
         with self._lock:
             return sum(e.fired for e in self._entries if e.point == point)
 
+    def has_point(self, point: str) -> bool:
+        """Is ``point`` armed at all (fired or not)?  Lets a caller decide
+        once, up front, whether a per-iteration check is worth running
+        (engine.py's preemption coordination)."""
+        with self._lock:
+            return any(e.point == point for e in self._entries)
+
 
 class NullFaults:
     """Disabled plan — the shared default; ``fire`` never triggers."""
@@ -131,6 +149,9 @@ class NullFaults:
 
     def fired(self, point: str) -> int:
         return 0
+
+    def has_point(self, point: str) -> bool:
+        return False
 
 
 NULL_FAULTS = NullFaults()

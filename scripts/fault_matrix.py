@@ -26,11 +26,20 @@ import numpy as np
 
 POLICIES = ("raise", "rollback", "clamp")
 FAULTS = ("none", "nan_grad@2", "inf_hess@2", "hist_fail_once",
-          "torn_checkpoint@4", "collective_fail_once")
+          "torn_checkpoint@4", "collective_fail_once", "preempt@2",
+          "torn_shard_rank@4", "torn_manifest@4", "rank_crash_in_barrier@4")
+# multi-process snapshot-set faults: protocol-level cells driven through a
+# simulated 2-rank group (sequential ranks + a disk-backed gather stub, the
+# tests/test_robustness.py harness); expected outcomes below.  They do not
+# interact with nonfinite_policy, so only the `raise` column runs them.
+MP_FAULTS = ("torn_shard_rank@4", "torn_manifest@4",
+             "rank_crash_in_barrier@4")
 # the ~2-minute tier loop runs this subset (tests/test_robustness.py)
 FAST_CELLS = {("none", "raise"), ("nan_grad@2", "raise"),
               ("nan_grad@2", "rollback"), ("torn_checkpoint@4", "raise"),
-              ("collective_fail_once", "raise")}
+              ("collective_fail_once", "raise"), ("preempt@2", "raise"),
+              ("torn_shard_rank@4", "raise"), ("torn_manifest@4", "raise"),
+              ("rank_crash_in_barrier@4", "raise")}
 
 
 def _data():
@@ -106,6 +115,25 @@ def _run_cell(fault: str, policy: str, X, y, workdir: str) -> str:
             return "ok" if bst.inner.save_model_to_string(-1) == ref \
                 else "resumed model differs from uninterrupted run"
 
+        if fault == "preempt@2":
+            # expected: clean loop exit at iteration 2 with a valid
+            # checkpoint; resume completes to the byte-identical
+            # uninterrupted model
+            ref = train().inner.save_model_to_string(-1)
+            out2 = os.path.join(os.path.dirname(out), "preempt", "m.txt")
+            bst = train({"fault_inject": fault, "output_model": out2})
+            if bst.current_iteration() != 2:
+                return f"stopped at {bst.current_iteration()}, expected 2"
+            from lightgbm_tpu import checkpoint as ck
+            if not os.path.exists(ck.snapshot_path(out2, 2)):
+                return "no preemption checkpoint on disk"
+            bst2 = train({"output_model": out2}, resume=True)
+            return "ok" if bst2.inner.save_model_to_string(-1) == ref \
+                else "preempt-resumed model differs from uninterrupted run"
+
+        if fault in MP_FAULTS:
+            return _run_mp_cell(fault, workdir)
+
         if fault == "collective_fail_once":
             faults.install("collective_fail_once")
             try:
@@ -122,6 +150,76 @@ def _run_cell(fault: str, policy: str, X, y, workdir: str) -> str:
         return f"unexpected {type(e).__name__}: {e}"
 
 
+def _run_mp_cell(fault: str, workdir: str) -> str:
+    """One simulated 2-rank snapshot-set cell.  Expected outcomes:
+
+    * ``torn_shard_rank@4``      — rank 1 dies tearing its shard; no
+      iteration-4 manifest is ever committed; the group resumes from 2.
+    * ``torn_manifest@4``        — rank 0 dies mid-manifest; the torn
+      manifest fails its CRC; the group resumes from 2.
+    * ``rank_crash_in_barrier@4`` — a rank dies between shard write and
+      barrier; nothing commits; the group resumes from 2.
+    """
+    import zlib
+
+    from lightgbm_tpu import checkpoint as ck
+    from lightgbm_tpu.utils import faults
+    from lightgbm_tpu.utils.faults import SimulatedCrash
+
+    world, fps = 2, [11, 22]
+    out = os.path.join(workdir, fault.replace("@", "_"), "m.txt")
+
+    def write_gather(it):
+        def gather(payload):
+            infos = []
+            for r in range(world):
+                p = ck.shard_path(out, it, r)
+                if os.path.exists(p):
+                    with open(p, "rb") as f:
+                        infos.append({"rank": r, "crc": zlib.crc32(f.read()),
+                                      "fingerprint": fps[r]})
+            return infos
+        return gather
+
+    def resume_gather(payload):
+        return [dict(zip(("ok", "fatal"),
+                         ck._local_valid_group_iters(out, r, world, fps[r])),
+                     rank=r) for r in range(world)]
+
+    def write_set(it, ranks=(1, 0)):
+        for r in ranks:
+            ck.write_group_snapshot(
+                out, it, "tree\n" if r == 0 else "",
+                {"version": 1, "iteration": it, "rank": r},
+                rank=r, world=world, fingerprint=fps[r],
+                gather=write_gather(it))
+
+    write_set(2)                      # the previous good set
+    faults.install(fault)
+    crashed = False
+    try:
+        # torn_shard_rank must hit a NON-zero rank (rank 1 writes first in
+        # the simulation); the barrier crash is exercised on rank 0
+        write_set(4, ranks=((0,) if "barrier" in fault else (1, 0)))
+    except SimulatedCrash:
+        crashed = True
+    finally:
+        faults.clear()
+    if not crashed:
+        return f"{fault} did not crash the snapshot write"
+    if fault != "torn_manifest@4" and \
+            os.path.exists(ck.manifest_path(out, 4)):
+        return "a manifest was committed despite the crash"
+    for r in range(world):
+        got = ck.find_latest_valid_group(out, rank=r, world=world,
+                                         fingerprint=fps[r],
+                                         gather=resume_gather)
+        if got is None or got[0] != 2:
+            return (f"rank {r} resumed from "
+                    f"{None if got is None else got[0]}, expected set 2")
+    return "ok"
+
+
 def run_matrix(fast: bool = False):
     """Returns (results, failures): results is {(fault, policy): msg}."""
     X, y = _data()
@@ -131,6 +229,9 @@ def run_matrix(fast: bool = False):
             for policy in POLICIES:
                 if fast and (fault, policy) not in FAST_CELLS:
                     continue
+                if policy != "raise" and (fault in MP_FAULTS
+                                          or fault == "preempt@2"):
+                    continue   # checkpoint-protocol cells are policy-blind
                 msg = _run_cell(fault, policy, X, y, workdir)
                 results[(fault, policy)] = msg
                 if msg != "ok":

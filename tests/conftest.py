@@ -66,8 +66,18 @@ SLOW_TESTS = {
 }
 
 
+# pinned in the FAST tier despite living in a slow module: the
+# multi-process kill-and-resume byte-identity contract (ISSUE 6 acceptance)
+# must gate every run, not just the full tier (~40 s, 3 worker pairs)
+FAST_EXCEPTIONS = {
+    "test_two_process_crash_resume_byte_identical",
+}
+
+
 def pytest_collection_modifyitems(items):
     for item in items:
+        if item.name in FAST_EXCEPTIONS:
+            continue
         if (item.module.__name__ in SLOW_MODULES
                 or item.name in SLOW_TESTS):
             item.add_marker(pytest.mark.slow)

@@ -110,6 +110,59 @@ if os.environ.get("TEST_MODE") == "sharedfile":
     print("WORKER_OK", rank)
     sys.exit(0)
 
+if os.environ.get("TEST_MODE") == "ckpt":
+    # coordinated multi-process checkpoints (docs/ROBUSTNESS.md): each rank
+    # holds a row partition whose score matrix no peer can reconstruct, so
+    # snapshots are per-rank shard sets committed by a rank-0 manifest
+    from lightgbm_tpu.parallel.sync import CollectiveError
+    from lightgbm_tpu.utils.faults import SimulatedCrash
+    phase = os.environ["TEST_CKPT_PHASE"]
+    snap_out = os.environ["TEST_SNAP_OUT"]
+    params = dict(objective="binary", num_leaves=15, min_data_in_leaf=10,
+                  learning_rate=0.2, verbose=-1, tree_learner="data",
+                  num_machines=2, machine_list_file=mlist,
+                  snapshot_freq=2, output_model=snap_out)
+    lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
+    d = lgb.Dataset(X[lo:hi], label=y[lo:hi])
+    if phase == "ref":                     # uninterrupted baseline
+        lgb.train(params, d, num_boost_round=6).save_model(out)
+        print("WORKER_OK", rank)
+        sys.exit(0)
+    if phase == "preempt":
+        # rank 1 "receives" the preemption notice (deterministic fault);
+        # the per-boundary flag allgather makes BOTH ranks checkpoint at
+        # iteration 3 and exit the loop cleanly
+        p = dict(params, preempt_signal="sigterm")
+        if rank == 1:
+            p["fault_inject"] = "preempt@3"
+        bst = lgb.train(p, d, num_boost_round=6)
+        assert bst.current_iteration() == 3, bst.current_iteration()
+        print("WORKER_OK", rank)
+        sys.exit(0)
+    if phase == "crash":
+        # kill ONE worker mid-run: rank 1 dies tearing its iteration-4
+        # shard; rank 0 must surface a named CollectiveError from the
+        # commit barrier (not hang), and no iteration-4 manifest may exist
+        p = dict(params, collective_timeout=10, collective_retries=0)
+        if rank == 1:
+            p["fault_inject"] = "torn_shard_rank@4"
+        try:
+            lgb.train(p, d, num_boost_round=6)
+        except (SimulatedCrash, CollectiveError) as e:
+            print("CRASHED", type(e).__name__)
+            print("WORKER_OK", rank)
+            sys.stdout.flush()
+            os._exit(0)      # skip atexit: a preempted pod gets no goodbye
+        print("NO_CRASH")
+        os._exit(1)
+    if phase == "resume":                  # both ranks resume + finish
+        bst = lgb.train(dict(params, snapshot_resume=True), d,
+                        num_boost_round=6)
+        bst.save_model(out)
+        print("WORKER_OK", rank)
+        sys.exit(0)
+    raise SystemExit(f"unknown ckpt phase {phase}")
+
 # this process's row partition (pre-partitioned parallel learning)
 lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
 
@@ -300,6 +353,78 @@ def test_feature_parallel_rejects_partitioned_data(tmp_path):
     """Feeding per-process row partitions to feature-parallel (full-data
     contract) must fail loudly, not train on inconsistent replicas."""
     _run_workers(tmp_path, mode="feature_bad")
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_two_process_crash_resume_byte_identical(tmp_path):
+    """THE multi-process resumability contract (docs/ROBUSTNESS.md): kill
+    one worker mid-run (rank 1 tears its iteration-4 shard and dies; rank
+    0 times out in the commit barrier), resume BOTH from the last
+    everywhere-committed set (iteration 2), and the final model is
+    byte-identical to an uninterrupted 2-process run on every rank."""
+    from lightgbm_tpu import checkpoint as ck
+
+    snap = tmp_path / "snaps"
+    snap.mkdir()
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    _run_workers(ref_dir, mode="ckpt", extra_env={
+        "TEST_CKPT_PHASE": "ref", "TEST_SNAP_OUT": str(ref_dir / "m.txt")})
+    ref0 = (ref_dir / "model_0.txt").read_text()
+    assert ref0 == (ref_dir / "model_1.txt").read_text()
+
+    crash_dir = tmp_path / "crash"
+    crash_dir.mkdir()
+    outs = _run_workers(crash_dir, mode="ckpt", extra_env={
+        "TEST_CKPT_PHASE": "crash", "TEST_SNAP_OUT": str(snap / "m.txt")})
+    assert any("CRASHED SimulatedCrash" in o for o in outs)
+    assert any("CRASHED CollectiveError" in o for o in outs)
+    # the iteration-2 set is committed; iteration 4 must have NO manifest
+    # (rank 1 died before the barrier) — shards without a manifest never
+    # happened
+    assert os.path.exists(ck.manifest_path(str(snap / "m.txt"), 2))
+    assert not os.path.exists(ck.manifest_path(str(snap / "m.txt"), 4))
+
+    resume_dir = tmp_path / "resume"
+    resume_dir.mkdir()
+    _run_workers(resume_dir, mode="ckpt", extra_env={
+        "TEST_CKPT_PHASE": "resume", "TEST_SNAP_OUT": str(snap / "m.txt")})
+    r0 = (resume_dir / "model_0.txt").read_text()
+    assert r0 == (resume_dir / "model_1.txt").read_text()
+    assert r0 == ref0, "resumed 2-process model differs from uninterrupted"
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_two_process_preempt_coordinated_exit(tmp_path):
+    """A preemption notice on ONE rank (deterministic `preempt@3` fault)
+    must make BOTH ranks write the same coordinated checkpoint set and
+    exit the loop cleanly at the same iteration — then resume to the
+    uninterrupted final model."""
+    from lightgbm_tpu import checkpoint as ck
+
+    snap = tmp_path / "snaps"
+    snap.mkdir()
+    pre_dir = tmp_path / "pre"
+    pre_dir.mkdir()
+    _run_workers(pre_dir, mode="ckpt", extra_env={
+        "TEST_CKPT_PHASE": "preempt", "TEST_SNAP_OUT": str(snap / "m.txt")})
+    # the coordinated preemption checkpoint: a committed iteration-3 set
+    man = ck.load_manifest(str(snap / "m.txt"), 3)
+    assert man["process_count"] == 2
+    assert len(man["shard_crc32"]) == 2
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    _run_workers(ref_dir, mode="ckpt", extra_env={
+        "TEST_CKPT_PHASE": "ref", "TEST_SNAP_OUT": str(ref_dir / "m.txt")})
+    resume_dir = tmp_path / "resume"
+    resume_dir.mkdir()
+    _run_workers(resume_dir, mode="ckpt", extra_env={
+        "TEST_CKPT_PHASE": "resume", "TEST_SNAP_OUT": str(snap / "m.txt")})
+    assert (resume_dir / "model_0.txt").read_text() == \
+        (ref_dir / "model_0.txt").read_text()
 
 
 def _free_port() -> int:

@@ -395,6 +395,301 @@ def test_dart_resume_byte_identical(tmp_path, small_binary):
     assert bst.inner.save_model_to_string(-1) == ref
 
 
+# --------------------------------- multi-process coordinated snapshot sets
+#
+# The group protocol is pure file+gather logic, so two "ranks" are driven
+# sequentially in ONE process with a stub gather that evaluates every
+# rank's local view — the real 2-process crash->resume byte-identity runs
+# in tests/test_multiprocess.py (tier-1 via conftest FAST_EXCEPTIONS).
+
+WORLD = 2
+FPS = [1111, 2222]        # per-rank dataset-partition fingerprints
+
+
+def _write_gather(out, it):
+    """Barrier stand-in for write: shard CRCs read back off disk."""
+    import zlib
+
+    def gather(payload):
+        infos = []
+        for r in range(WORLD):
+            p = ckpt.shard_path(out, it, r)
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    infos.append({"rank": r, "crc": zlib.crc32(f.read()),
+                                  "fingerprint": FPS[r]})
+        return infos
+    return gather
+
+
+def _resume_gather(out, fps=None):
+    """Resume-barrier stand-in: every rank's local scan, allgathered."""
+    fps = fps or FPS
+
+    def gather(payload):
+        return [dict(zip(("ok", "fatal"),
+                         ckpt._local_valid_group_iters(out, r, WORLD,
+                                                       fps[r])),
+                     rank=r) for r in range(WORLD)]
+    return gather
+
+
+def _write_set(out, it, ranks=(1, 0)):
+    """One committed snapshot set (rank 0 last: it writes the manifest)."""
+    for r in ranks:
+        ckpt.write_group_snapshot(
+            out, it, "tree\n" if r == 0 else "",
+            {"version": 1, "iteration": it, "rank": r},
+            rank=r, world=WORLD, fingerprint=FPS[r],
+            gather=_write_gather(out, it))
+
+
+def test_group_snapshot_roundtrip(tmp_path):
+    out = str(tmp_path / "m.txt")
+    _write_set(out, 2)
+    _write_set(out, 4)
+    for r in range(WORLD):
+        it, path, state = ckpt.find_latest_valid_group(
+            out, rank=r, world=WORLD, fingerprint=FPS[r],
+            gather=_resume_gather(out))
+        assert it == 4 and state["rank"] == r
+        assert path == ckpt.shard_path(out, 4, r)
+    man = ckpt.load_manifest(out, 4)
+    assert man["process_count"] == WORLD
+    assert man["data_fingerprint"] == FPS
+
+
+def test_torn_shard_on_one_rank_demotes_group(tmp_path):
+    """The acceptance contract: a torn shard on ANY single rank demotes
+    the WHOLE group to the previous good set — even ranks whose own
+    shards are fine."""
+    counters.reset()
+    out = str(tmp_path / "m.txt")
+    _write_set(out, 2)
+    _write_set(out, 4)
+    sp = ckpt.shard_path(out, 4, 1)
+    with open(sp, "rb") as f:
+        data = f.read()
+    with open(sp, "wb") as f:
+        f.write(data[:len(data) // 2])       # torn shard, rank 1 only
+    for r in range(WORLD):                   # BOTH ranks demote to 2
+        it, _, state = ckpt.find_latest_valid_group(
+            out, rank=r, world=WORLD, fingerprint=FPS[r],
+            gather=_resume_gather(out))
+        assert it == 2 and state["iteration"] == 2
+    evs = counters.events("checkpoint_skipped")
+    assert any(e["iteration"] == 4 and "CRC" in e["reason"] for e in evs)
+    assert any("demoted" in e["reason"] for e in evs)
+
+
+def test_topology_mismatch_is_structured_error(tmp_path):
+    """Resuming a 2-process set with a different process count is a
+    CheckpointError naming the topology — never silent divergence."""
+    out = str(tmp_path / "m.txt")
+    _write_set(out, 2)
+
+    def gather3(payload):
+        ok, fatal = ckpt._local_valid_group_iters(out, 0, 3, FPS[0])
+        return [{"rank": 0, "ok": ok, "fatal": fatal}]
+
+    with pytest.raises(ckpt.CheckpointError, match="process"):
+        ckpt.find_latest_valid_group(out, rank=0, world=3,
+                                     fingerprint=FPS[0], gather=gather3)
+
+
+def test_partition_fingerprint_mismatch_is_structured_error(tmp_path):
+    out = str(tmp_path / "m.txt")
+    _write_set(out, 2)
+    with pytest.raises(ckpt.CheckpointError, match="fingerprint"):
+        ckpt.find_latest_valid_group(
+            out, rank=0, world=WORLD, fingerprint=FPS[0],
+            gather=_resume_gather(out, fps=[9999, FPS[1]]))
+
+
+def test_torn_manifest_demotes_to_previous_set(tmp_path):
+    """rank 0 dies mid-manifest-write: the set was never committed, the
+    group falls back to the previous good set (no error)."""
+    out = str(tmp_path / "m.txt")
+    _write_set(out, 2)
+    faults.install("torn_manifest@4")
+    with pytest.raises(SimulatedCrash, match="torn_manifest"):
+        _write_set(out, 4)
+    faults.clear()
+    assert os.path.exists(ckpt.manifest_path(out, 4))   # torn file exists
+    it, _, _ = ckpt.find_latest_valid_group(
+        out, rank=0, world=WORLD, fingerprint=FPS[0],
+        gather=_resume_gather(out))
+    assert it == 2
+
+
+def test_rank_crash_in_barrier_never_commits(tmp_path):
+    """A rank dying between its shard write and the barrier leaves no
+    manifest: the set never existed."""
+    out = str(tmp_path / "m.txt")
+    _write_set(out, 2)
+    faults.install("rank_crash_in_barrier@4")
+    with pytest.raises(SimulatedCrash, match="barrier"):
+        _write_set(out, 4, ranks=(0,))
+    faults.clear()
+    assert os.path.exists(ckpt.shard_path(out, 4, 0))
+    assert not os.path.exists(ckpt.manifest_path(out, 4))
+    it, _, _ = ckpt.find_latest_valid_group(
+        out, rank=0, world=WORLD, fingerprint=FPS[0],
+        gather=_resume_gather(out))
+    assert it == 2
+
+
+def test_explicit_group_resume_pins_one_set(tmp_path):
+    out = str(tmp_path / "m.txt")
+    _write_set(out, 2)
+    _write_set(out, 4)
+    it, _, _ = ckpt.find_latest_valid_group(
+        out, rank=0, world=WORLD, fingerprint=FPS[0],
+        gather=_resume_gather(out),
+        only_iteration=ckpt.iteration_from_path(ckpt.shard_path(out, 2, 0)))
+    assert it == 2
+    with pytest.raises(ckpt.CheckpointError, match="not valid"):
+        ckpt.find_latest_valid_group(
+            out, rank=0, world=WORLD, fingerprint=FPS[0],
+            gather=_resume_gather(out), only_iteration=3)
+
+
+def test_prune_is_set_aware_no_orphans(tmp_path):
+    """snapshot_keep pruning removes whole sets — manifest first — and
+    never strands orphan rank shards."""
+    out = str(tmp_path / "m.txt")
+    for it in (2, 4, 6):
+        _write_set(out, it)
+    # a plain single-process snapshot mixed in (iteration 3)
+    ckpt.write_atomic(ckpt.snapshot_path(out, 3),
+                      ckpt.encode("tree\n", {"version": 1, "iteration": 3}))
+    ckpt.prune_snapshots(out, 2)
+    left = sorted(os.listdir(tmp_path))
+    assert left == [
+        "m.txt.snapshot_iter_4.manifest", "m.txt.snapshot_iter_4.rank_0",
+        "m.txt.snapshot_iter_4.rank_1",
+        "m.txt.snapshot_iter_6.manifest", "m.txt.snapshot_iter_6.rank_0",
+        "m.txt.snapshot_iter_6.rank_1"]
+
+
+def test_write_atomic_tmp_name_is_rank_keyed(tmp_path, monkeypatch):
+    """Two ranks with the SAME pid on a shared filesystem (distinct hosts)
+    must not collide on the tmp file: the name carries the process index."""
+    seen = []
+    real_replace = os.replace
+
+    def spy(src, dst):
+        seen.append(os.path.basename(src))
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", spy)
+    monkeypatch.setattr(ckpt, "_process_index", lambda: 0)
+    ckpt.write_atomic(str(tmp_path / "f"), b"a")
+    monkeypatch.setattr(ckpt, "_process_index", lambda: 1)
+    ckpt.write_atomic(str(tmp_path / "f"), b"b")
+    assert len(seen) == 2 and seen[0] != seen[1]
+    assert f".f.tmp.r0.{os.getpid()}" in seen[0]
+    assert f".f.tmp.r1.{os.getpid()}" in seen[1]
+
+
+# ------------------------------------------------------- preemption safety
+
+def test_preempt_fault_checkpoints_and_resumes(tmp_path, small_binary):
+    """`preempt@K`: training writes a checkpoint at the iteration-K
+    boundary and exits the loop cleanly; resume completes to the
+    byte-identical uninterrupted model."""
+    X, y = small_binary
+    out = str(tmp_path / "m.txt")
+    tr, _ = _datasets(X, y)
+    ref = lgb.train(_params(out), tr, num_boost_round=8,
+                    verbose_eval=False).inner.save_model_to_string(-1)
+
+    out2 = str(tmp_path / "p" / "m.txt")
+    tr, _ = _datasets(X, y)
+    counters.reset()
+    bst = lgb.train(_params(out2, fault_inject="preempt@3"), tr,
+                    num_boost_round=8, verbose_eval=False)
+    assert bst.current_iteration() == 3
+    assert os.path.exists(ckpt.snapshot_path(out2, 3))
+    evs = counters.events("preempt_checkpoint")
+    assert len(evs) == 1 and evs[0]["iteration"] == 3
+
+    tr, _ = _datasets(X, y)
+    bst2 = lgb.train(_params(out2), tr, num_boost_round=8,
+                     verbose_eval=False, resume=True)
+    assert bst2.inner.save_model_to_string(-1) == ref
+
+
+def test_preempt_real_sigterm(tmp_path, small_binary):
+    """The actual signal path: SIGTERM mid-iteration flips the watch, the
+    next boundary checkpoints + exits cleanly, and the previous handler
+    is restored after train()."""
+    import signal
+
+    X, y = small_binary
+    out = str(tmp_path / "m.txt")
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def send_sigterm(env):
+        if env.iteration == 1:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    tr, _ = _datasets(X, y)
+    bst = lgb.train(_params(out, preempt_signal="sigterm"), tr,
+                    num_boost_round=8, verbose_eval=False,
+                    callbacks=[send_sigterm])
+    assert bst.current_iteration() == 2     # boundary after iteration idx 1
+    assert os.path.exists(ckpt.snapshot_path(out, 2))
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_preempt_signal_param_validated():
+    with pytest.raises(Exception):
+        lgb.train({"objective": "binary", "preempt_signal": "sigkill",
+                   "verbose": -1},
+                  lgb.Dataset(np.zeros((10, 2)), label=np.zeros(10)))
+
+
+def test_single_process_checkpointing_adds_zero_collectives(tmp_path,
+                                                            small_binary):
+    """Acceptance: with snapshots, resume, AND an armed preemption watch,
+    single-process training issues ZERO host-object collectives (the
+    comm_audit contract for the training loop's host side)."""
+    X, y = small_binary
+    out = str(tmp_path / "m.txt")
+    tr, _ = _datasets(X, y)
+    lgb.train(_params(out, telemetry=True, preempt_signal="sigterm"), tr,
+              num_boost_round=4, verbose_eval=False, resume=True)
+    assert counters.get("collective_calls") == {}
+    assert counters.get("collective_bytes") == {}
+
+
+def test_checkpoint_skip_warnings_carry_events():
+    """Grep lint (the PR 5 layout_downgrade discipline applied to the
+    checkpoint layer): every snapshot-skip/demotion warning in
+    checkpoint.py must emit a structured checkpoint_skipped event within
+    the same block."""
+    import re
+    src_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightgbm_tpu", "checkpoint.py")
+    with open(src_path) as f:
+        src = f.read()
+    lines = src.splitlines()
+    checked, missing = 0, []
+    for m in re.finditer(r"log\.warning\(", src):
+        line_no = src.count("\n", 0, m.start()) + 1
+        window = "\n".join(lines[max(0, line_no - 6):line_no + 5])
+        if "Skipping" not in window and "demot" not in window.lower():
+            continue
+        checked += 1
+        if "_skip_event" not in window:
+            missing.append(line_no)
+    assert checked >= 3, "lint matched too few checkpoint warnings"
+    assert not missing, (
+        f"checkpoint skip warnings without a checkpoint_skipped event at "
+        f"lines {missing}")
+
+
 # -------------------------------------------------- satellite: fault matrix
 
 def test_fault_matrix_fast_subset():
