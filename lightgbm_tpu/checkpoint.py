@@ -169,7 +169,15 @@ class PreemptionWatch:
     signal lands.  The handler only flips :attr:`requested`; all actual
     work happens at the loop boundary where the training state is
     consistent.  ``install``/``restore`` scope the handlers to one
-    ``train()`` call."""
+    ``train()`` call.
+
+    **Double-signal semantics**: a SECOND notice of a watched signal while
+    the first request is still being honored (typically: the coordinated
+    preempt checkpoint is in flight) means the platform is done waiting —
+    the handler raises ``SystemExit(128 + signum)`` immediately instead of
+    re-queuing, and the ``finally`` that wraps the training loop restores
+    the previous handlers on the way out.  SIGINT behaves identically to
+    SIGTERM when listed in ``preempt_signal``."""
 
     def __init__(self, spec: str):
         self.spec = str(spec or "")
@@ -188,6 +196,15 @@ class PreemptionWatch:
         return sigs
 
     def _on_signal(self, signum, frame) -> None:
+        if self.requested:
+            # second notice while the first is being honored: the platform
+            # is done waiting — exit NOW (the in-flight atomic write leaves
+            # either the old file or the new one, never a torn checkpoint,
+            # and train()'s finally restores the handlers)
+            log.warning("second preemption signal (%d) before the "
+                        "coordinated checkpoint completed; exiting "
+                        "immediately", signum)
+            raise SystemExit(128 + int(signum))
         self.requested = True
 
     def install(self) -> "PreemptionWatch":
@@ -218,6 +235,204 @@ def iteration_from_path(path: str) -> Optional[int]:
     iteration."""
     m = re.search(r"\.snapshot_iter_(\d+)", str(path))
     return int(m.group(1)) if m else None
+
+
+# ------------------------------------------------- liveness: heartbeat files
+
+def heartbeat_path(output_model: str, rank: int) -> str:
+    return f"{output_model}.heartbeat.rank_{rank}"
+
+
+class Heartbeat:
+    """Per-rank liveness stamp (``heartbeat_interval`` param): one tiny
+    JSON line — iteration, wall-time, pid — rewritten atomically at each
+    iteration boundary, throttled to at most one write per ``interval``
+    seconds (plus the forced stamps at loop entry/exit).  Pure host-side
+    file writes: no fsync (liveness, not durability — the reader trusts
+    mtime recency, not crash persistence), no collectives, no device
+    syncs.  The supervisor declares a rank hung when the file's mtime is
+    older than ``hang_timeout``, so the stamp cadence bounds detection
+    latency at ``iteration_time + interval``.
+
+    The ``slow_heartbeat`` fault point makes writes silently never land
+    (the stalled-NFS failure mode): the rank is alive but looks dead to
+    file-based liveness."""
+
+    def __init__(self, path: str, interval: float):
+        self.path = path
+        self.interval = float(interval)
+        self._last = 0.0
+
+    def stamp(self, iteration: int, force: bool = False) -> None:
+        import json
+        import time
+        now = time.time()
+        if not force and now - self._last < self.interval:
+            return
+        fi = faults_mod.get_faults()
+        if fi.enabled and fi.fire("slow_heartbeat", iteration):
+            return
+        self._last = now
+        line = json.dumps({"iteration": int(iteration), "time": now,
+                           "pid": os.getpid()}) + "\n"
+        # atomic but UNSYNCED: a heartbeat that evaporates in a crash is
+        # indistinguishable from the death it would have reported anyway
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(line)
+            os.replace(tmp, self.path)
+        except OSError as e:           # liveness must never kill training
+            log.debug("heartbeat write failed: %s", e)
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+
+def read_heartbeat(path: str):
+    """``(iteration, age_seconds)`` of a heartbeat file, or ``None`` when
+    it is missing/unreadable/garbled (a torn heartbeat is just a stale
+    one — the supervisor falls back to the file's absence semantics)."""
+    import json
+    import time
+    try:
+        age = time.time() - os.stat(path).st_mtime
+        with open(path) as f:
+            rec = json.loads(f.readline())
+        return int(rec["iteration"]), age
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+# --------------------------------------------------- per-rank crash reports
+
+def crash_report_path(output_model: str, rank: int) -> str:
+    return f"{output_model}.crash.rank_{rank}"
+
+
+def write_crash_report(output_model: str, rank: int,
+                       exc: Optional[BaseException] = None) -> Optional[str]:
+    """Flush a per-rank crash report on abnormal exit: the exception, a
+    ``faulthandler`` dump of every thread's stack, and the tail of this
+    rank's obs event ring — so a supervisor (or a human) can read WHY a
+    rank died without re-running under a debugger.  Best-effort by
+    construction: a crash report about a crashing process must never mask
+    the original failure.  Returns the path written, or None."""
+    import faulthandler
+    import json
+    import time
+    import traceback
+    path = crash_report_path(output_model, rank)
+    try:
+        from .obs.counters import counters
+        events = counters.events_tail(64)
+    except Exception:                  # pragma: no cover - obs import issues
+        events = []
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(f"# crash report: rank {rank}, pid {os.getpid()}, "
+                    f"time {time.time():.3f}\n")
+            if exc is not None:
+                f.write("## exception\n")
+                f.write("".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__)))
+            f.write("## thread stacks (faulthandler)\n")
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+            f.write(f"\n## obs event ring tail ({len(events)} events)\n")
+            for e in events:
+                f.write(json.dumps(e, default=str) + "\n")
+        return path
+    except Exception as e:             # pragma: no cover - dying process
+        try:
+            log.debug("crash report write failed: %s", e)
+        except Exception:
+            pass
+        return None
+
+
+# -------------------------------------------------- startup hygiene: sweeps
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):  # pragma: no cover - foreign pid
+        return True                     # exists but not ours: leave it be
+
+_TMP_RE = re.compile(r"\.tmp\.r(\d+)\.(\d+)$")
+
+
+def sweep_stale_tmp(output_model: str, crash_reports: bool = False,
+                    heartbeats: bool = False) -> List[str]:
+    """Startup hygiene for crashed ranks: remove ``.tmp.r<rank>.<pid>``
+    atomic-write leftovers whose writer pid is dead (a SIGKILLed rank's
+    half-written tmp otherwise lives forever on a shared filesystem), and
+    — when asked — orphan crash reports and heartbeat files from previous
+    incarnations.  Live pids are never touched: a peer rank mid-write
+    keeps its tmp.  Returns the removed paths; every removal is recorded
+    as a ``stale_sweep`` obs event so the cleanup is observable."""
+    from .obs.counters import counters
+    base = os.path.basename(output_model)
+    d = os.path.dirname(os.path.abspath(output_model))
+    removed: List[str] = []
+    victims: List[Tuple[str, str]] = []
+    for p in glob.glob(os.path.join(glob.escape(d),
+                                    "." + glob.escape(base) + "*.tmp.r*.*")):
+        m = _TMP_RE.search(p)
+        if m and not _pid_alive(int(m.group(2))):
+            victims.append((p, f"stale tmp (rank {m.group(1)}, dead pid "
+                               f"{m.group(2)})"))
+    if crash_reports:
+        victims += [(p, "orphan crash report") for p in
+                    glob.glob(glob.escape(output_model) + ".crash.rank_*")]
+    if heartbeats:
+        victims += [(p, "stale heartbeat") for p in
+                    glob.glob(glob.escape(output_model)
+                              + ".heartbeat.rank_*")]
+    for p, why in victims:
+        try:
+            os.unlink(p)
+        except OSError:                # pragma: no cover - races/permissions
+            continue
+        removed.append(p)
+        counters.event("stale_sweep", path=p, reason=why)
+    if removed:
+        log.info("Swept %d stale file(s) for %s", len(removed), output_model)
+    return removed
+
+
+def latest_committed_iteration(output_model: str) -> Optional[int]:
+    """The newest iteration with a durable commit under this prefix, from
+    THIS process's view of the filesystem: the max over valid plain
+    snapshots and snapshot sets whose manifest validates.  No gather, no
+    shard-CRC audit — this is the supervisor's forward-progress marker
+    (did the group commit anything since the last restart?), not the
+    resume agreement (:func:`find_latest_valid_group` stays that)."""
+    best: Optional[int] = None
+    for it, path in reversed(list_snapshots(output_model)):
+        try:
+            load_snapshot(path)
+        except CheckpointError:
+            continue
+        best = it
+        break
+    for it in sorted(list_snapshot_sets(output_model), reverse=True):
+        if best is not None and it <= best:
+            break
+        try:
+            load_manifest(output_model, it)
+        except CheckpointError:
+            continue
+        best = it
+        break
+    return best
 
 
 # ------------------------------------------------------------ capture/restore
@@ -528,6 +743,10 @@ def find_latest_valid_group(output_model: str, *, rank: int, world: int,
     ``only_iteration`` pins resume to one explicit set: anything less than
     group-wide validity of exactly that set raises."""
     gather = gather or _default_gather()
+    # startup hygiene: a previous incarnation SIGKILLed mid-write left
+    # .tmp.r<rank>.<pid> leftovers behind — their pids are dead by the time
+    # a group resumes, so sweep them here (live writers are never touched)
+    sweep_stale_tmp(output_model)
     ok, fatal = _local_valid_group_iters(output_model, rank, world,
                                          fingerprint)
     views = gather({"rank": rank, "ok": ok, "fatal": fatal})

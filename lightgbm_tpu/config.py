@@ -242,6 +242,36 @@ class Config:
                                      # e.g. nan_grad@3,torn_checkpoint@4,
                                      # collective_fail_once (utils/faults.py;
                                      # also via LGBM_TPU_FAULT_INJECT env)
+    heartbeat_interval: float = 0.0  # per-rank liveness heartbeats
+                                     # (docs/ROBUSTNESS.md "Self-healing
+                                     # training"): > 0 stamps iteration +
+                                     # wall-time into
+                                     # <output_model>.heartbeat.rank_R at
+                                     # each iteration boundary, at most
+                                     # once per this many seconds — pure
+                                     # host-side file writes, zero added
+                                     # collectives or device syncs.  The
+                                     # supervisor reads the stamps for
+                                     # hang detection; 0 = off
+    hang_timeout: float = 0.0        # supervisor hang detection: a rank
+                                     # whose heartbeat is older than this
+                                     # many seconds is declared hung and
+                                     # the group is restarted from the
+                                     # last committed checkpoint.  Raised
+                                     # automatically to exceed the
+                                     # collective ladder's worst case so
+                                     # an in-band CollectiveError gets a
+                                     # chance to surface first; 0 = the
+                                     # supervisor default (300 s)
+    restart_limit: int = 3           # supervisor restart budget: give up
+                                     # (restart_budget_exhausted) after
+                                     # this many group restarts WITHOUT
+                                     # forward progress — a restart after
+                                     # a newer committed checkpoint
+                                     # resets the budget
+    restart_backoff: float = 1.0     # seconds before the first group
+                                     # relaunch; doubles per restart
+                                     # while no forward progress is made
     preempt_signal: str = ""         # preemption safety: signals that
                                      # request a coordinated checkpoint at
                                      # the next iteration boundary and a
@@ -482,9 +512,18 @@ def check_param_conflicts(cfg: Config) -> None:
         # fail at parse time with the real cause, not at the injection point
         from .utils.faults import parse_spec
         try:
-            parse_spec(cfg.fault_inject)
+            entries = parse_spec(cfg.fault_inject)
         except ValueError as e:
             log.fatal("%s", e)
+        else:
+            world = max(1, cfg.num_machines)
+            for e in entries:
+                # a rank qualifier naming a rank the job does not run
+                # would silently inject nothing — reject it here
+                if e.rank is not None and e.rank >= world:
+                    log.fatal("fault_inject: rank=%d targets a rank this "
+                              "job does not run (num_machines=%d)",
+                              e.rank, world)
     if cfg.preempt_signal:
         for tok in str(cfg.preempt_signal).replace(",", " ").split():
             if tok.strip().lower() not in ("sigterm", "sigint", "term",
@@ -501,6 +540,22 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.collective_retries < 0:
         log.fatal("collective_retries must be >= 0; got %d",
                   cfg.collective_retries)
+    if cfg.heartbeat_interval < 0:
+        log.fatal("heartbeat_interval must be >= 0 seconds (0 = off); "
+                  "got %r", cfg.heartbeat_interval)
+    if cfg.hang_timeout < 0:
+        log.fatal("hang_timeout must be >= 0 seconds (0 = the supervisor "
+                  "default); got %r", cfg.hang_timeout)
+    if cfg.hang_timeout and cfg.heartbeat_interval \
+            and cfg.hang_timeout <= cfg.heartbeat_interval:
+        log.fatal("hang_timeout (%g s) must exceed heartbeat_interval "
+                  "(%g s): every rank would look hung between two stamps",
+                  cfg.hang_timeout, cfg.heartbeat_interval)
+    if cfg.restart_limit < 0:
+        log.fatal("restart_limit must be >= 0; got %d", cfg.restart_limit)
+    if cfg.restart_backoff < 0:
+        log.fatal("restart_backoff must be >= 0 seconds; got %r",
+                  cfg.restart_backoff)
     if cfg.pallas_hist_impl == "nibble":
         # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
         # axis and tiles (feat_tile * 16) output lanes — reject shapes it

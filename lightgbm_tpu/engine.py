@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import os
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -233,6 +234,39 @@ def train(params: Dict[str, Any], train_set: Dataset,
     preempt_armed = preempt_watch.armed or \
         faults_mod.get_faults().has_point("preempt")
 
+    # liveness heartbeats (docs/ROBUSTNESS.md "Self-healing training"):
+    # stamp iteration + wall-time into <output_model>.heartbeat.rank_R at
+    # each boundary — pure host-side file writes on the happy path (the
+    # zero-collectives pin of PR 6 extends over this), read by the
+    # supervisor's hang detection.  Arming heartbeats also arms the
+    # per-rank crash report on abnormal exit.
+    heartbeat_interval = float(params.get("heartbeat_interval", 0) or 0)
+    heartbeat = None
+    if heartbeat_interval > 0:
+        heartbeat = checkpoint_mod.Heartbeat(
+            checkpoint_mod.heartbeat_path(snapshot_out, rank),
+            heartbeat_interval)
+        heartbeat.stamp(start_iter, force=True)
+
+    def _boundary_liveness(iteration: int) -> None:
+        """Once per iteration boundary: the supervisor-matrix fault points
+        (a hard rank death / a wedged rank), then the heartbeat stamp."""
+        fi = faults_mod.get_faults()
+        if fi.enabled and fi.fire("rank_crash", iteration):
+            log.warning("rank_crash fault: rank %d dying hard at "
+                        "iteration %d (os._exit, no checkpoint, no "
+                        "goodbye)", rank, iteration)
+            os._exit(70)
+        if fi.enabled and fi.fire("rank_hang", iteration):
+            log.warning("rank_hang fault: rank %d wedging at iteration %d "
+                        "(stand-in for a stuck device collective; "
+                        "heartbeats stop now)", rank, iteration)
+            import time as _time
+            while True:          # only SIGKILL — or the supervisor — ends this
+                _time.sleep(3600)
+        if heartbeat is not None:
+            heartbeat.stamp(iteration)
+
     train_span = obs_trace.get_tracer().span(
         "train", num_boost_round=num_boost_round)
     try:
@@ -265,6 +299,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         booster.best_score.setdefault(
                             item[0], {})[item[1]] = item[2]
                     break
+                # BEFORE the snapshot block: a rank_crash/rank_hang at
+                # boundary K dies with iterations since the last committed
+                # set genuinely lost — the shape of a real mid-run death
+                _boundary_liveness(i + 1)
                 wrote_snapshot = False
                 if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
                     # gbdt.cpp:456-460's snapshot cadence, upgraded to an
@@ -303,6 +341,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if booster.best_iteration <= 0:
             booster.best_iteration = booster.current_iteration()
         booster.inner.timers.report("training phase timers")
+        if heartbeat is not None:
+            heartbeat.stamp(booster.current_iteration(), force=True)
+    except BaseException as e:
+        # abnormal exit with heartbeats armed (i.e. a supervised rank):
+        # flush a per-rank crash report — exception, every thread's stack,
+        # the obs event-ring tail — so the supervisor can say WHY this
+        # rank died without anyone re-running under a debugger.
+        # EarlyStopException never reaches here (handled at the boundary);
+        # SystemExit from the double-signal path and SimulatedCrash from
+        # the fault matrix are exactly the deaths worth a report.
+        if heartbeat is not None:
+            checkpoint_mod.write_crash_report(snapshot_out, rank, exc=e)
+        raise
     finally:
         preempt_watch.restore()   # handlers are scoped to THIS training
         if telemetry_on:

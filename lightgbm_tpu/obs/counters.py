@@ -21,7 +21,14 @@ users:
   (iteration + reason for every torn/demoted snapshot the scan rejected),
   ``checkpoint_resume`` (iteration + ``kind=single|group``), and
   ``preempt_checkpoint`` (clean preemption exits) — so a resumed run's
-  telemetry explains exactly which snapshot it continued from and why.
+  telemetry explains exactly which snapshot it continued from and why;
+* **supervisor lifecycle events** — the self-healing supervisor
+  (:mod:`lightgbm_tpu.supervisor`) records every liveness decision:
+  ``rank_dead`` (exit code + last heartbeat), ``rank_hang`` (heartbeat
+  age vs the effective hang timeout), ``group_restart`` (attempt, resume
+  iteration, backoff), ``restart_budget_exhausted``, ``crash_report``
+  (a rank left one behind), and ``stale_sweep`` (startup hygiene
+  removals) — an unattended recovery is never an unexplained one.
 
 Counts recorded from inside jit tracing are TRACE-time counts (once per
 compiled call site), which is exactly the "per call site" identity the
@@ -103,6 +110,14 @@ class CounterRegistry:
             evs = list(self._events)
         return evs if name is None else [e for e in evs
                                          if e.get("event") == name]
+
+    def events_tail(self, n: int) -> List[dict]:
+        """The newest ``n`` events across all names — what a crash report
+        flushes (checkpoint.write_crash_report): the last things this
+        process observed before dying."""
+        with self._lock:
+            evs = list(self._events)
+        return evs[-max(0, int(n)):]
 
     def events_dropped(self) -> int:
         with self._lock:

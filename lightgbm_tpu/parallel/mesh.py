@@ -97,6 +97,35 @@ def parse_machine_list(path: str):
     return machines
 
 
+def write_machine_list(path: str, machines) -> None:
+    """Inverse of :func:`parse_machine_list` — the supervisor rewrites the
+    list when it refreshes ports between group relaunches."""
+    with open(path, "w") as f:
+        for ip, port in machines:
+            f.write(f"{ip} {port}\n")
+
+
+def refresh_local_ports(path: str) -> None:
+    """Re-point every loopback entry of a machine list at a freshly bound
+    (and immediately released) port.  A restarted group reuses its machine
+    list, but the dead coordinator's listen port can linger in TIME_WAIT —
+    on a single-host group (the CI harness, local supervised runs) fresh
+    ports per incarnation make relaunch deterministic.  Non-local entries
+    (a real multi-host fleet) are left untouched: their ports are
+    infrastructure, not ours to rebind."""
+    import socket
+    machines = parse_machine_list(path)
+    out = []
+    for ip, port in machines:
+        if ip in ("127.0.0.1", "localhost"):
+            s = socket.socket()
+            s.bind((ip if ip != "localhost" else "127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+        out.append((ip, port))
+    write_machine_list(path, out)
+
+
 def _local_rank(machines) -> Optional[int]:
     """Find this host in the machine list by its addresses — the reference's
     rank discovery (linkers.cpp matches local interface IPs).  The

@@ -96,27 +96,64 @@ def process_index() -> int:
 
 def _with_timeout(fn: Callable[[], Any], timeout: float, what: str) -> Any:
     """Run ``fn`` with a deadline.  The underlying collective cannot be
-    cancelled, but a named timeout beats an indefinite silent hang."""
+    cancelled, but a named timeout beats an indefinite silent hang.
+
+    A timed-out attempt is marked **abandoned** before the caller raises:
+    the worker thread keeps running (nothing can cancel it), and when the
+    collective eventually completes *late* its result is dropped — and
+    the drop recorded as a ``collective_late_completion`` obs event —
+    instead of mutating the result box after the caller already raised
+    ``CollectiveError`` (or double-counting the ``collective_calls``
+    accounting through a retry that is also in flight)."""
     import threading
     out: List[Any] = []
     err: List[BaseException] = []
+    lock = threading.Lock()
+    abandoned = [False]
 
     def run():
         try:
-            out.append(fn())
+            result = fn()
         except BaseException as e:   # re-raised on the caller thread
-            err.append(e)
+            with lock:
+                if abandoned[0]:
+                    _note_late(what, f"{type(e).__name__}: {e}")
+                    return
+                err.append(e)
+            return
+        with lock:
+            if abandoned[0]:
+                _note_late(what, "completed")
+                return
+            out.append(result)
 
     t = threading.Thread(target=run, daemon=True, name=f"sync:{what}")
     t.start()
     t.join(timeout)
-    if t.is_alive():
+    with lock:
+        # the attempt may finish between the join timeout and this lock —
+        # a result that made it into the box in time still counts
+        if not out and not err:
+            abandoned[0] = True
+    if abandoned[0]:
         raise CollectiveError(
             f"{what} timed out after {timeout:g}s (a peer process is "
             "stuck or dead; see machine_list_file ordering for ranks)")
     if err:
         raise err[0]
     return out[0]
+
+
+def _note_late(what: str, outcome: str) -> None:
+    """A previously abandoned collective attempt just finished: log it and
+    record the structured event (never silent — a late completion is the
+    evidence that ``collective_timeout`` raced a slow peer, exactly what
+    the supervisor's hang-vs-timeout composition needs to see)."""
+    from ..obs.counters import counters
+    counters.inc("collective_late_completions", op=what)
+    counters.event("collective_late_completion", op=what, outcome=outcome)
+    log.warning("%s attempt completed LATE (%s) after its timeout had "
+                "already surfaced; result dropped", what, outcome)
 
 
 def _retrying(what: str, attempt_fn: Callable[[], Any]) -> Any:

@@ -1,0 +1,363 @@
+"""Self-healing training: the supervisor process.
+
+PR 6 made multi-process training *resumable* — coordinated shard-set
+checkpoints with a rank-0 manifest commit point, a resume barrier, and
+SIGTERM preemption safety.  But recovery was still a human: a crashed
+rank, a wedged collective, or an OOM-killed worker left the group dead
+until someone reran the job with ``snapshot_resume=true``.  This module
+closes that loop::
+
+    python -m lightgbm_tpu.supervisor config=train.conf num_machines=2 \
+        heartbeat_interval=1 hang_timeout=120 restart_limit=3
+
+The supervisor spawns the rank processes and watches two liveness
+signals, cheapest first:
+
+* **exit codes** — a rank that dies (crash, OOM kill, ``SimulatedCrash``
+  from the fault matrix) is seen at the next poll: ``rank_dead``;
+* **heartbeat files** — each rank stamps iteration + wall-time into
+  ``<output_model>.heartbeat.rank_R`` at every iteration boundary
+  (``heartbeat_interval`` param; pure host-side writes, zero added
+  collectives).  A live process whose stamp is older than the effective
+  hang timeout is wedged: ``rank_hang``.
+
+``hang_timeout`` **composes with** ``collective_timeout``: the effective
+timeout is raised to exceed the collective ladder's worst case
+(``collective_timeout * (collective_retries + 1)`` plus slack), so a rank
+stuck in a *host-object* collective surfaces in-band first — as a named
+``CollectiveError`` that kills the rank and leaves a crash report — and
+the heartbeat path only has to catch what nothing in-band can: a stuck
+device collective, a livelocked host loop, a rank wedged before init.
+
+On either signal the supervisor runs one **restart cycle**:
+
+1. **teardown** — SIGTERM to every live rank first (the PR 6
+   ``preempt_signal`` path: a *healthy* group member writes a coordinated
+   checkpoint and exits cleanly — best-effort, since a dead peer fails
+   the commit barrier after ``collective_timeout``), then SIGKILL to
+   whatever is left after ``term_grace`` seconds;
+2. **triage** — per-rank crash reports (``<output_model>.crash.rank_R``,
+   written by the rank itself on abnormal exit: exception, all-thread
+   stacks, obs event-ring tail) are surfaced as ``crash_report`` events;
+3. **budget** — restarts are bounded by ``restart_limit`` with
+   exponential ``restart_backoff``; the budget **resets after forward
+   progress** (a restart that finds a newer committed checkpoint than the
+   last one proves the job advances between failures — a crash loop at a
+   fixed iteration does not);
+4. **relaunch** — stale atomic-write tmp files are swept
+   (:func:`lightgbm_tpu.checkpoint.sweep_stale_tmp`), and the group is
+   respawned with the same command line; workers run with
+   ``snapshot_resume=true`` so they agree on the newest everywhere-valid
+   set through the PR 6 resume barrier.  The final model is byte-identical
+   to an uninterrupted run (pinned by ``tests/test_supervisor.py``).
+
+Every decision is a structured obs event — ``rank_dead`` / ``rank_hang`` /
+``group_restart`` / ``restart_budget_exhausted`` / ``crash_report`` /
+``stale_sweep`` — an unattended recovery is never an unexplained one.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import checkpoint as checkpoint_mod
+from .obs.counters import counters
+from .utils import log
+
+DEFAULT_HANG_TIMEOUT = 300.0
+# the restart counter each incarnation sees: lets a test harness (or a
+# canary deployment) arm behavior on the FIRST incarnation only
+ATTEMPT_ENV = "LGBM_TPU_SUPERVISOR_ATTEMPT"
+
+
+def effective_hang_timeout(hang_timeout: float, heartbeat_interval: float,
+                           collective_timeout: Optional[float],
+                           collective_retries: int = 0) -> float:
+    """The hang timeout actually enforced: the configured one, raised to
+    clear the collective ladder's worst case so an in-band
+    ``CollectiveError`` gets its chance to surface first (the rank then
+    dies with an exit code + crash report — far better evidence than
+    "heartbeat went quiet")."""
+    t = float(hang_timeout) if hang_timeout and hang_timeout > 0 \
+        else DEFAULT_HANG_TIMEOUT
+    if collective_timeout and collective_timeout > 0:
+        floor = (float(collective_timeout) * (int(collective_retries) + 1)
+                 + float(heartbeat_interval) + 1.0)
+        if t < floor:
+            log.warning("hang_timeout %gs raised to %gs so the collective "
+                        "ladder (timeout %gs x %d attempt(s)) can surface "
+                        "an in-band CollectiveError first", t, floor,
+                        collective_timeout, collective_retries + 1)
+            t = floor
+    return t
+
+
+class _Rank:
+    __slots__ = ("rank", "proc", "spawned_at")
+
+    def __init__(self, rank: int, proc: subprocess.Popen, spawned_at: float):
+        self.rank = rank
+        self.proc = proc
+        self.spawned_at = spawned_at
+
+
+class Supervisor:
+    """Spawn, watch, and heal one training group.
+
+    ``argv`` is the worker command line, identical for every rank and
+    every relaunch; rank identity travels as the ``LGBM_TPU_RANK``
+    environment variable (the mesh bring-up convention) and the restart
+    count as ``LGBM_TPU_SUPERVISOR_ATTEMPT``.  ``prelaunch`` runs before
+    every (re)launch — e.g. :func:`parallel.mesh.refresh_local_ports` for
+    single-host groups whose dead coordinator port may linger in
+    TIME_WAIT."""
+
+    def __init__(self, argv: Sequence[str], output_model: str,
+                 world: int = 1, *,
+                 heartbeat_interval: float = 1.0,
+                 hang_timeout: float = 0.0,
+                 restart_limit: int = 3,
+                 restart_backoff: float = 1.0,
+                 collective_timeout: Optional[float] = None,
+                 collective_retries: int = 0,
+                 term_grace: Optional[float] = None,
+                 startup_grace: Optional[float] = None,
+                 poll_interval: float = 0.1,
+                 env: Optional[Dict[str, str]] = None,
+                 prelaunch: Optional[Callable[["Supervisor"], None]] = None):
+        self.argv = list(argv)
+        self.output_model = str(output_model)
+        self.world = max(1, int(world))
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.hang_timeout = effective_hang_timeout(
+            hang_timeout, heartbeat_interval, collective_timeout,
+            collective_retries)
+        # before the FIRST heartbeat of an incarnation lands the rank is
+        # "starting", not "beating" — runtime imports + device init +
+        # grower compiles happen there, so the no-file-yet verdict uses a
+        # separate (more generous) deadline than the stale-file one
+        self.startup_grace = float(startup_grace) \
+            if startup_grace is not None else max(self.hang_timeout, 60.0)
+        self.restart_limit = max(0, int(restart_limit))
+        self.restart_backoff = max(0.0, float(restart_backoff))
+        self.term_grace = float(term_grace) if term_grace is not None \
+            else (float(collective_timeout or 10.0) + 5.0)
+        self.poll_interval = float(poll_interval)
+        self.env = dict(env or {})
+        self.prelaunch = prelaunch
+        self.attempt = 0              # total relaunches so far
+        self._ranks: List[_Rank] = []
+        self._progress_mark: Optional[int] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def run(self) -> int:
+        """Supervise until the group completes (returns 0) or the restart
+        budget is exhausted (returns 1)."""
+        d = os.path.dirname(os.path.abspath(self.output_model))
+        os.makedirs(d, exist_ok=True)
+        # startup hygiene: leftovers of PREVIOUS jobs under this prefix —
+        # dead-pid atomic-write tmps, orphan crash reports, stale
+        # heartbeats — are swept before the first spawn
+        checkpoint_mod.sweep_stale_tmp(self.output_model,
+                                       crash_reports=True, heartbeats=True)
+        self._progress_mark = checkpoint_mod.latest_committed_iteration(
+            self.output_model)
+        restarts_since_progress = 0
+        self._launch()
+        while True:
+            time.sleep(self.poll_interval)
+            verdict = self._check()
+            if verdict is None:
+                continue
+            if verdict == "done":
+                log.info("Supervisor: all %d rank(s) completed cleanly "
+                         "(%d restart(s) along the way)", self.world,
+                         self.attempt)
+                return 0
+            reason, rank, detail = verdict
+            self._teardown()
+            self._collect_crash_reports()
+            it = checkpoint_mod.latest_committed_iteration(self.output_model)
+            if it is not None and (self._progress_mark is None
+                                   or it > self._progress_mark):
+                # forward progress since the last restart: the job is
+                # advancing between failures — refill the budget
+                self._progress_mark = it
+                restarts_since_progress = 0
+            restarts_since_progress += 1
+            if restarts_since_progress > self.restart_limit:
+                counters.event("restart_budget_exhausted",
+                               limit=self.restart_limit,
+                               attempts=self.attempt + 1,
+                               reason=reason, rank=rank,
+                               resume_iteration=it)
+                log.warning("Supervisor: restart budget exhausted (%d "
+                          "restart(s) without forward progress, last "
+                          "failure: %s on rank %d); giving up — the last "
+                          "committed checkpoint is iteration %s",
+                          self.restart_limit, reason, rank, it)
+                return 1
+            delay = self.restart_backoff * (2 ** (restarts_since_progress - 1))
+            self.attempt += 1
+            counters.event("group_restart", attempt=self.attempt,
+                           restarts_since_progress=restarts_since_progress,
+                           resume_iteration=it, backoff=delay,
+                           reason=reason, rank=rank, detail=detail)
+            log.warning("Supervisor: %s (rank %d, %s) — restarting the "
+                        "group from committed iteration %s in %.2gs "
+                        "(restart %d/%d since last progress)", reason, rank,
+                        detail, it, delay, restarts_since_progress,
+                        self.restart_limit)
+            if delay > 0:
+                time.sleep(delay)
+            self._launch()
+
+    def _launch(self) -> None:
+        # a fresh incarnation must not inherit the previous one's liveness
+        # artifacts: dead-pid tmps and old heartbeat stamps are swept
+        # (crash reports stay until read by _collect_crash_reports)
+        checkpoint_mod.sweep_stale_tmp(self.output_model, heartbeats=True)
+        if self.prelaunch is not None:
+            self.prelaunch(self)
+        self._ranks = []
+        for r in range(self.world):
+            env = dict(os.environ)
+            env.update(self.env)
+            env["LGBM_TPU_RANK"] = str(r)
+            env[ATTEMPT_ENV] = str(self.attempt)
+            logf = open(f"{self.output_model}.rank_{r}.log", "ab")
+            try:
+                proc = subprocess.Popen(self.argv, env=env, stdout=logf,
+                                        stderr=subprocess.STDOUT)
+            finally:
+                logf.close()      # the child holds its own fd now
+            self._ranks.append(_Rank(r, proc, time.time()))
+        log.info("Supervisor: launched %d rank(s) (attempt %d): %s",
+                 self.world, self.attempt, " ".join(self.argv))
+
+    # ------------------------------------------------------------- liveness
+
+    def _check(self):
+        """One poll: ``None`` (healthy), ``"done"`` (all ranks exited 0),
+        or ``(reason, rank, detail)`` for the first failure seen."""
+        all_done = True
+        for rk in self._ranks:
+            rc = rk.proc.poll()
+            if rc is None:
+                all_done = False
+            elif rc != 0:
+                hb = checkpoint_mod.read_heartbeat(
+                    checkpoint_mod.heartbeat_path(self.output_model,
+                                                  rk.rank))
+                counters.event("rank_dead", rank=rk.rank, exit_code=rc,
+                               last_heartbeat_iteration=(
+                                   hb[0] if hb else None))
+                return ("rank_dead", rk.rank, f"exit code {rc}")
+        if all_done:
+            return "done"
+        now = time.time()
+        for rk in self._ranks:
+            if rk.proc.poll() is not None:      # exited 0: stops beating
+                continue
+            hb = checkpoint_mod.read_heartbeat(
+                checkpoint_mod.heartbeat_path(self.output_model, rk.rank))
+            age = hb[1] if hb is not None else now - rk.spawned_at
+            deadline = self.hang_timeout if hb is not None \
+                else self.startup_grace
+            if age > deadline:
+                counters.event("rank_hang", rank=rk.rank,
+                               heartbeat_age=round(age, 3),
+                               hang_timeout=deadline,
+                               phase="beating" if hb else "starting",
+                               iteration=(hb[0] if hb else None))
+                return ("rank_hang", rk.rank,
+                        f"heartbeat {age:.1f}s old (timeout {deadline:g}s"
+                        + ("" if hb else ", never stamped") + ")")
+        return None
+
+    # ------------------------------------------------------------- teardown
+
+    def _teardown(self) -> None:
+        """Escalating group stop: SIGTERM first (the ``preempt_signal``
+        path — a healthy rank checkpoints and exits cleanly), SIGKILL for
+        whatever is still alive after ``term_grace`` seconds."""
+        live = [rk for rk in self._ranks if rk.proc.poll() is None]
+        for rk in live:
+            try:
+                rk.proc.terminate()
+            except OSError:      # pragma: no cover - exited under our feet
+                pass
+        deadline = time.time() + self.term_grace
+        for rk in live:
+            try:
+                rk.proc.wait(timeout=max(0.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                log.warning("Supervisor: rank %d still alive %gs after "
+                            "SIGTERM; escalating to SIGKILL", rk.rank,
+                            self.term_grace)
+                try:
+                    rk.proc.kill()
+                except OSError:  # pragma: no cover - exited under our feet
+                    pass
+                rk.proc.wait()
+
+    def _collect_crash_reports(self) -> None:
+        for r in range(self.world):
+            path = checkpoint_mod.crash_report_path(self.output_model, r)
+            if not os.path.exists(path):
+                continue
+            counters.event("crash_report", rank=r, path=path,
+                           bytes=os.path.getsize(path))
+            log.warning("Supervisor: rank %d left a crash report: %s",
+                        r, path)
+
+
+# ------------------------------------------------------------------ CLI
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m lightgbm_tpu.supervisor <cli args>``: supervise the
+    equivalent ``python -m lightgbm_tpu.cli`` training.  The worker
+    command is the SAME argument list plus ``snapshot_resume=true`` (so
+    every incarnation resumes from the newest everywhere-valid set — a
+    first launch with no snapshots trains from scratch) and the effective
+    ``heartbeat_interval``."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from .cli import parse_cli
+    from .config import config_from_params
+    params = parse_cli(argv)
+    cfg = config_from_params(params)
+    log.set_verbosity(cfg.verbose)
+    heartbeat = cfg.heartbeat_interval if cfg.heartbeat_interval > 0 else 1.0
+    worker_argv = ([sys.executable, "-m", "lightgbm_tpu.cli"] + argv +
+                   [f"heartbeat_interval={heartbeat}",
+                    "snapshot_resume=true"])
+    prelaunch = None
+    if cfg.num_machines > 1 and cfg.machine_list_file:
+        from .parallel import mesh
+
+        def prelaunch(sup, _path=cfg.machine_list_file):
+            # single-host groups: the dead coordinator's port can linger
+            # in TIME_WAIT; refresh loopback entries per incarnation
+            # (non-local entries are left untouched)
+            mesh.refresh_local_ports(_path)
+    sup = Supervisor(
+        worker_argv, cfg.output_model, cfg.num_machines,
+        heartbeat_interval=heartbeat, hang_timeout=cfg.hang_timeout,
+        restart_limit=cfg.restart_limit,
+        restart_backoff=cfg.restart_backoff,
+        collective_timeout=cfg.collective_timeout,
+        collective_retries=cfg.collective_retries, prelaunch=prelaunch)
+    rc = sup.run()
+    for name in ("rank_dead", "rank_hang", "group_restart",
+                 "restart_budget_exhausted"):
+        for e in counters.events(name):
+            log.info("supervisor event: %s", e)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,16 @@ Spec grammar (``fault_inject`` param / ``LGBM_TPU_FAULT_INJECT`` env)::
   a rolled-back iteration is re-entered at the same index and must not
   re-poison itself);
 * ``point_once`` — fire on the first hit, regardless of iteration;
-* ``point``      — fire on every hit.
+* ``point``      — fire on every hit;
+* ``point…:rank=R`` — rank qualifier: the entry only fires in the process
+  whose distributed rank is ``R`` (``rank_crash@3:rank=1`` kills exactly
+  rank 1 at iteration 3).  Every process in a group receives the same
+  ``fault_inject`` spec, so without the qualifier a multi-process fault
+  lands on whichever rank parses the env var first; with it, a
+  ``fault_matrix`` cell targets one specific process.  The rank is
+  resolved at fire time from ``LGBM_TPU_RANK`` (the supervisor/harness
+  convention) or the distributed runtime; config parsing rejects ranks
+  outside ``num_machines``.
 
 Known points (unknown names are rejected at parse time so a typo'd spec
 fails fast instead of silently injecting nothing):
@@ -41,6 +50,16 @@ fails fast instead of silently injecting nothing):
                      committed and resume demotes to the previous good set
 ``rank_crash_in_barrier``  this rank dies after its shard write but before
                      the commit barrier
+``rank_crash``       hard process death at an iteration boundary
+                     (``os._exit`` — no exception, no checkpoint, no
+                     goodbye; what the supervisor's exit-code liveness
+                     must catch)
+``rank_hang``        the process wedges at an iteration boundary (sleeps
+                     forever, heartbeats stop — the stand-in for a stuck
+                     device collective; what ``hang_timeout`` must catch)
+``slow_heartbeat``   heartbeat writes silently never land (stalled NFS
+                     stand-in): the rank is alive and progressing but
+                     looks dead to file-based liveness
 ===================  ========================================================
 
 Mirrors the :mod:`lightgbm_tpu.obs.trace` singleton discipline: when no
@@ -56,7 +75,28 @@ from typing import List, Optional
 
 KNOWN_POINTS = ("torn_checkpoint", "nan_grad", "inf_hess", "collective_fail",
                 "collective_corrupt", "hist_fail", "preempt",
-                "torn_shard_rank", "torn_manifest", "rank_crash_in_barrier")
+                "torn_shard_rank", "torn_manifest", "rank_crash_in_barrier",
+                "rank_crash", "rank_hang", "slow_heartbeat")
+
+
+def current_rank() -> int:
+    """The distributed rank a ``:rank=R`` qualifier is checked against.
+
+    ``LGBM_TPU_RANK`` (set by the supervisor, the CLI mesh bring-up, and
+    the multi-process test harness) wins so the check never has to touch
+    the jax backend; otherwise ask the distributed runtime (0 when it is
+    not up — the single-process identity)."""
+    env = os.environ.get("LGBM_TPU_RANK")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    try:
+        from ..parallel.sync import process_index
+        return process_index()
+    except Exception:        # pragma: no cover - jax import/backend issues
+        return 0
 
 
 class InjectedFault(RuntimeError):
@@ -68,12 +108,14 @@ class SimulatedCrash(RuntimeError):
 
 
 class _Entry:
-    __slots__ = ("point", "iteration", "once", "fired")
+    __slots__ = ("point", "iteration", "once", "rank", "fired")
 
-    def __init__(self, point: str, iteration: Optional[int], once: bool):
+    def __init__(self, point: str, iteration: Optional[int], once: bool,
+                 rank: Optional[int] = None):
         self.point = point
         self.iteration = iteration
         self.once = once
+        self.rank = rank
         self.fired = 0
 
 
@@ -84,6 +126,20 @@ def parse_spec(spec: str) -> List[_Entry]:
         tok = raw.strip()
         if not tok:
             continue
+        rank: Optional[int] = None
+        if ":" in tok:
+            tok, qual = tok.split(":", 1)
+            q = qual.strip().lower()
+            if not q.startswith("rank="):
+                raise ValueError(f"fault_inject: unknown qualifier in "
+                                 f"{raw!r} (only :rank=R is understood)")
+            try:
+                rank = int(q[len("rank="):])
+            except ValueError:
+                raise ValueError(f"fault_inject: bad rank in {raw!r}")
+            if rank < 0:
+                raise ValueError(f"fault_inject: rank must be >= 0 in "
+                                 f"{raw!r}")
         iteration: Optional[int] = None
         if "@" in tok:
             tok, it = tok.split("@", 1)
@@ -98,7 +154,7 @@ def parse_spec(spec: str) -> List[_Entry]:
         if tok not in KNOWN_POINTS:
             raise ValueError(f"fault_inject: unknown point {tok!r} "
                              f"(known: {', '.join(KNOWN_POINTS)})")
-        entries.append(_Entry(tok, iteration, once))
+        entries.append(_Entry(tok, iteration, once, rank))
     return entries
 
 
@@ -115,12 +171,18 @@ class FaultPlan:
         """Should ``point`` trigger now?  One call = one hit (one-shot
         entries burn on the hit that matches them)."""
         hit = False
+        rank: Optional[int] = None     # resolved lazily, at most once
         with self._lock:
             for e in self._entries:
                 if e.point != point:
                     continue
                 if e.iteration is not None and e.iteration != iteration:
                     continue
+                if e.rank is not None:
+                    if rank is None:
+                        rank = current_rank()
+                    if e.rank != rank:
+                        continue
                 if e.once and e.fired:
                     continue
                 e.fired += 1

@@ -27,19 +27,39 @@ import numpy as np
 POLICIES = ("raise", "rollback", "clamp")
 FAULTS = ("none", "nan_grad@2", "inf_hess@2", "hist_fail_once",
           "torn_checkpoint@4", "collective_fail_once", "preempt@2",
-          "torn_shard_rank@4", "torn_manifest@4", "rank_crash_in_barrier@4")
+          "torn_shard_rank@4", "torn_manifest@4", "rank_crash_in_barrier@4",
+          "rank_crash@3", "rank_hang@3", "slow_heartbeat", "rank_crash")
 # multi-process snapshot-set faults: protocol-level cells driven through a
 # simulated 2-rank group (sequential ranks + a disk-backed gather stub, the
 # tests/test_robustness.py harness); expected outcomes below.  They do not
 # interact with nonfinite_policy, so only the `raise` column runs them.
 MP_FAULTS = ("torn_shard_rank@4", "torn_manifest@4",
              "rank_crash_in_barrier@4")
+# self-healing supervisor cells (docs/ROBUSTNESS.md "Self-healing
+# training"): each runs a real supervised worker process through
+# lightgbm_tpu.supervisor with one liveness fault and asserts the
+# supervisor's verdict — automatic recovery to the byte-identical
+# uninterrupted model, or a clean restart_budget_exhausted give-up for
+# the crash-loop cell (bare `rank_crash` dies at the first boundary of
+# EVERY incarnation, so no forward progress ever refills the budget).
+# Policy-blind like the MP cells: only the `raise` column runs them.
+SUP_FAULTS = {                       # fault -> expected supervisor outcome
+    "rank_crash@3": "recovered",     # hard death -> rank_dead -> restart
+    "rank_hang@3": "recovered",      # wedged rank -> rank_hang via
+    #                                  hang_timeout -> SIGKILL escalation
+    "slow_heartbeat": "recovered",   # heartbeats never land: a live rank
+    #                                  looks dead -> false-positive restart
+    #                                  still converges
+    "rank_crash": "budget_exhausted",
+}
 # the ~2-minute tier loop runs this subset (tests/test_robustness.py)
 FAST_CELLS = {("none", "raise"), ("nan_grad@2", "raise"),
               ("nan_grad@2", "rollback"), ("torn_checkpoint@4", "raise"),
               ("collective_fail_once", "raise"), ("preempt@2", "raise"),
               ("torn_shard_rank@4", "raise"), ("torn_manifest@4", "raise"),
-              ("rank_crash_in_barrier@4", "raise")}
+              ("rank_crash_in_barrier@4", "raise"),
+              ("rank_crash@3", "raise"), ("rank_hang@3", "raise"),
+              ("rank_crash", "raise")}
 
 
 def _data():
@@ -134,6 +154,9 @@ def _run_cell(fault: str, policy: str, X, y, workdir: str) -> str:
         if fault in MP_FAULTS:
             return _run_mp_cell(fault, workdir)
 
+        if fault in SUP_FAULTS:
+            return _run_sup_cell(fault, X, y, workdir)
+
         if fault == "collective_fail_once":
             faults.install("collective_fail_once")
             try:
@@ -220,6 +243,126 @@ def _run_mp_cell(fault: str, workdir: str) -> str:
     return "ok"
 
 
+# the supervised worker: deterministic single-rank training, fault armed
+# through the environment — FAULT_ALWAYS=1 re-arms it in every incarnation
+# (the crash-loop cell); otherwise only the FIRST incarnation is poisoned
+# (LGBM_TPU_SUPERVISOR_ATTEMPT, set by the supervisor) so the restarted
+# group can prove recovery.
+SUP_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from lightgbm_tpu.utils.cache import enable_persistent_cache
+enable_persistent_cache()   # warm grower compiles across incarnations —
+#                             an iteration that recompiles from scratch
+#                             every restart would dwarf the hang timeouts
+#                             these cells probe
+import lightgbm_tpu as lgb
+
+d = np.load(os.environ["SUP_DATA"])
+params = dict(objective="binary", num_leaves=4, verbose=-1,
+              snapshot_freq=2, output_model=os.environ["SUP_OUT"],
+              heartbeat_interval=0.05, preempt_signal="sigterm")
+first = os.environ.get("LGBM_TPU_SUPERVISOR_ATTEMPT", "0") == "0"
+fault = os.environ.get("SUP_FAULT", "")
+if fault and (first or os.environ.get("SUP_FAULT_ALWAYS") == "1"):
+    params["fault_inject"] = fault
+bst = lgb.train(params, lgb.Dataset(d["X"], label=d["y"],
+                                    free_raw_data=False),
+                num_boost_round=6, verbose_eval=False, resume=True)
+if "slow_heartbeat" in params.get("fault_inject", ""):
+    # the poisoned incarnation must outlive the hang timeout: its
+    # boundary stamps never landed, so a rank that is alive and done
+    # LOOKS wedged to file-based liveness — linger until the
+    # false-positive verdict fires and the supervisor kills us
+    import time
+    time.sleep(60)
+bst.save_model(os.environ["SUP_OUT"])
+"""
+
+_SUP_REF = {}     # workdir -> uninterrupted supervised model text
+
+
+def _run_supervised(fault: str, workdir: str, out: str, *,
+                    always: bool = False, hang_timeout: float = 1.0,
+                    startup_grace: float = 60.0, restart_limit: int = 3):
+    """One supervised run; returns the Supervisor's exit code."""
+    from lightgbm_tpu.supervisor import Supervisor
+    script = os.path.join(workdir, "sup_worker.py")
+    data = os.path.join(workdir, "sup_data.npz")
+    if not os.path.exists(script):
+        with open(script, "w") as f:
+            f.write(SUP_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {"SUP_DATA": data, "SUP_OUT": out, "SUP_FAULT": fault,
+           "SUP_FAULT_ALWAYS": "1" if always else "",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    sup = Supervisor([sys.executable, script], out, 1,
+                     heartbeat_interval=0.05, hang_timeout=hang_timeout,
+                     startup_grace=startup_grace,
+                     restart_limit=restart_limit, restart_backoff=0.05,
+                     term_grace=1.0, poll_interval=0.05, env=env)
+    return sup.run()
+
+
+def _run_sup_cell(fault: str, X, y, workdir: str) -> str:
+    """One self-healing supervisor cell (expected outcomes: SUP_FAULTS)."""
+    import numpy as np
+
+    from lightgbm_tpu.obs.counters import counters
+
+    data = os.path.join(workdir, "sup_data.npz")
+    if not os.path.exists(data):
+        np.savez(data, X=np.asarray(X[:200], np.float64),
+                 y=np.asarray(y[:200], np.float64))
+    if workdir not in _SUP_REF:       # uninterrupted supervised baseline
+        ref_out = os.path.join(workdir, "sup_ref", "m.txt")
+        # generous hang timeout: this run may pay the cold grower compile
+        # (and warms the persistent cache for every cell after it)
+        if _run_supervised("", workdir, ref_out, hang_timeout=60.0) != 0:
+            return "uninterrupted supervised baseline failed"
+        with open(ref_out) as f:
+            _SUP_REF[workdir] = f.read()
+    counters.reset()
+    out = os.path.join(workdir, "sup_" + fault.replace("@", "_"), "m.txt")
+    expect = SUP_FAULTS[fault]
+    # slow_heartbeat is armed per-boundary (@1..@6) so the forced stamp at
+    # train entry still LANDS: the cell then exercises the stale-file
+    # verdict deterministically (the file exists, then goes silent while
+    # the rank lingers alive) instead of racing the jax-import window
+    # against the startup grace
+    spec = fault if fault != "slow_heartbeat" else ",".join(
+        f"slow_heartbeat@{k}" for k in range(1, 7))
+    rc = _run_supervised(
+        spec, workdir, out,
+        always=(expect == "budget_exhausted"),
+        restart_limit=(1 if expect == "budget_exhausted" else 3),
+        # hang verdicts need a timeout above the (cache-warm) iteration
+        # cost but low enough to keep the cell quick; crash verdicts ride
+        # exit codes and never consult it
+        hang_timeout=(6.0 if fault in ("rank_hang@3", "slow_heartbeat")
+                      else 60.0))
+    if expect == "budget_exhausted":
+        if rc == 0:
+            return "crash loop completed instead of exhausting the budget"
+        if not counters.events("restart_budget_exhausted"):
+            return "no restart_budget_exhausted event"
+        return "ok"
+    if rc != 0:
+        return f"supervisor gave up (exit {rc}) instead of recovering"
+    want_event = "rank_hang" if fault in ("rank_hang@3",
+                                          "slow_heartbeat") else "rank_dead"
+    if not counters.events(want_event):
+        return f"no {want_event} event behind the recovery"
+    if not counters.events("group_restart"):
+        return "recovered without a group_restart event"
+    with open(out) as f:
+        got = f.read()
+    return "ok" if got == _SUP_REF[workdir] \
+        else "self-healed model differs from uninterrupted run"
+
+
 def run_matrix(fast: bool = False):
     """Returns (results, failures): results is {(fault, policy): msg}."""
     X, y = _data()
@@ -230,8 +373,9 @@ def run_matrix(fast: bool = False):
                 if fast and (fault, policy) not in FAST_CELLS:
                     continue
                 if policy != "raise" and (fault in MP_FAULTS
+                                          or fault in SUP_FAULTS
                                           or fault == "preempt@2"):
-                    continue   # checkpoint-protocol cells are policy-blind
+                    continue   # checkpoint/supervisor cells are policy-blind
                 msg = _run_cell(fault, policy, X, y, workdir)
                 results[(fault, policy)] = msg
                 if msg != "ok":

@@ -309,6 +309,68 @@ def test_collective_budget_configurable():
         sync.configure(timeout=120.0, retries=2)
 
 
+def _wait_for_event(name, deadline=5.0):
+    import time
+    end = time.time() + deadline
+    while time.time() < end:
+        evs = counters.events(name)
+        if evs:
+            return evs
+        time.sleep(0.01)
+    return counters.events(name)
+
+
+def test_with_timeout_late_completion_dropped():
+    """Satellite pin (abandoned-thread hazard): a timed-out collective's
+    worker thread keeps running — when it completes LATE its result must
+    be dropped and recorded as a ``collective_late_completion`` event, not
+    appended to the result box the caller already abandoned (where a
+    concurrent retry would see a stale value or double-count obs)."""
+    import threading
+    counters.reset()
+    release = threading.Event()
+
+    def slow():
+        release.wait(10.0)
+        return "late result"
+
+    with pytest.raises(sync.CollectiveError, match="timed out"):
+        sync._with_timeout(slow, 0.05, "allgather_object")
+    assert counters.events("collective_late_completion") == []
+    release.set()                      # NOW the abandoned attempt finishes
+    evs = _wait_for_event("collective_late_completion")
+    assert len(evs) == 1 and evs[0]["op"] == "allgather_object" \
+        and evs[0]["outcome"] == "completed"
+    assert counters.get("collective_late_completions") == \
+        {"op=allgather_object": 1}
+
+
+def test_with_timeout_late_failure_dropped_too():
+    """The raising flavor of the same race: an abandoned attempt that
+    eventually FAILS must not inject its exception into a caller that
+    already raised CollectiveError — dropped, with the outcome named."""
+    import threading
+    counters.reset()
+    release = threading.Event()
+
+    def slow_fail():
+        release.wait(10.0)
+        raise RuntimeError("peer came back wrong")
+
+    with pytest.raises(sync.CollectiveError, match="timed out"):
+        sync._with_timeout(slow_fail, 0.05, "broadcast_object")
+    release.set()
+    evs = _wait_for_event("collective_late_completion")
+    assert len(evs) == 1 and evs[0]["op"] == "broadcast_object"
+    assert "RuntimeError" in evs[0]["outcome"]
+
+
+def test_with_timeout_in_time_result_still_counts():
+    """A completion that lands between the join timeout and the abandon
+    mark is NOT dropped — only a genuinely empty box abandons."""
+    assert sync._with_timeout(lambda: 42, 5.0, "allgather_object") == 42
+
+
 # ------------------------------------------------- satellite: rollback exact
 
 def test_rollback_one_iter_multiclass_bit_exact():
@@ -643,6 +705,53 @@ def test_preempt_real_sigterm(tmp_path, small_binary):
     assert signal.getsignal(signal.SIGTERM) is prev
 
 
+def _double_signal_case(tmp_path, small_binary, spec, sig):
+    """Shared body for the double-signal pins: the FIRST delivery of a
+    watched signal requests the boundary checkpoint; a SECOND delivery
+    while that request is still being honored forces immediate exit
+    (``SystemExit(128 + signum)``, no re-queue) and the train() finally
+    restores the previous handlers.  ``signal.raise_signal`` delivers
+    synchronously to this thread, so the Python-level handler runs at the
+    next bytecode boundary — the two deliveries cannot coalesce."""
+    import signal
+
+    X, y = small_binary
+    out = str(tmp_path / "m.txt")
+    prev = signal.getsignal(sig)
+
+    def send_two(env):
+        if env.iteration == 1:
+            signal.raise_signal(sig)     # flips requested at next bytecode
+            signal.raise_signal(sig)     # in flight -> exits NOW
+
+    tr, _ = _datasets(X, y)
+    with pytest.raises(SystemExit) as ei:
+        lgb.train(_params(out, preempt_signal=spec,
+                          heartbeat_interval=0.001), tr,
+                  num_boost_round=8, verbose_eval=False,
+                  callbacks=[send_two])
+    assert ei.value.code == 128 + int(sig)
+    assert signal.getsignal(sig) is prev     # restored in the finally
+    # the abnormal exit left a crash report naming the forced exit
+    report = ckpt.crash_report_path(out, 0)
+    assert os.path.exists(report) and "SystemExit" in open(report).read()
+
+
+def test_double_sigterm_forces_immediate_exit(tmp_path, small_binary):
+    """Satellite pin: a second SIGTERM while the coordinated preempt
+    checkpoint is in flight must force immediate exit, not re-queue."""
+    import signal
+    _double_signal_case(tmp_path, small_binary, "sigterm", signal.SIGTERM)
+
+
+def test_double_sigint_behaves_identically(tmp_path, small_binary):
+    """SIGINT listed in preempt_signal gets the SAME double-signal
+    semantics as SIGTERM (exit code 130, handlers restored)."""
+    import signal
+    _double_signal_case(tmp_path, small_binary, "sigterm,sigint",
+                        signal.SIGINT)
+
+
 def test_preempt_signal_param_validated():
     with pytest.raises(Exception):
         lgb.train({"objective": "binary", "preempt_signal": "sigkill",
@@ -688,6 +797,36 @@ def test_checkpoint_skip_warnings_carry_events():
     assert not missing, (
         f"checkpoint skip warnings without a checkpoint_skipped event at "
         f"lines {missing}")
+
+
+def test_recovery_layer_swallows_carry_events():
+    """Grep lint (the checkpoint-layer discipline extended over the
+    self-healing layer, ISSUE 7 satellite): every ``except Exception`` /
+    ``except BaseException`` handler in supervisor.py and parallel/sync.py
+    must either re-raise or emit a structured obs record
+    (``counters.event`` / ``counters.inc`` / ``_note_late``) within its
+    block — a silent swallow in the recovery path is how an unattended
+    restart becomes an unexplainable one."""
+    import re
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightgbm_tpu")
+    checked, missing = 0, []
+    for rel in ("supervisor.py", os.path.join("parallel", "sync.py")):
+        with open(os.path.join(pkg, rel)) as f:
+            src = f.read()
+        lines = src.splitlines()
+        for m in re.finditer(r"except (?:Exception|BaseException)\b", src):
+            line_no = src.count("\n", 0, m.start()) + 1
+            window = "\n".join(lines[line_no - 1:line_no + 9])
+            checked += 1
+            if not any(tok in window for tok in
+                       ("raise", "counters.event", "counters.inc",
+                        "_note_late")):
+                missing.append((rel, line_no))
+    assert checked >= 2, "lint matched too few recovery-path handlers"
+    assert not missing, (
+        f"recovery-path exception swallows without a structured obs "
+        f"record: {missing}")
 
 
 # -------------------------------------------------- satellite: fault matrix
