@@ -47,7 +47,12 @@ Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
 tpu, =0 to disable) is the deep-tree fixed-cost micro-rung: marginal ms
 per additional leaf between 31- and 255-leaf trees at <= 200k rows —
 the per-split fixed overhead the round-7 work collapsed, tracked per
-round.  The "telemetry" block
+round.  "serving" (cpu rung by default; BENCH_SERVING=1 to force on tpu,
+=0 to disable) is the high-QPS inference micro-rung (docs/SERVING.md):
+p50/p99 latency + QPS of the SoA microbatch engine at 1/64/4096-row
+batches on the freshly trained model, the speedup over the per-tree
+Predictor.predict host loop, and a mixed-size async replay pinned to
+zero recompiles via the predict_jit_entries gauge.  The "telemetry" block
 carries the OBSERVED histogram-kernel identity (lightgbm_tpu.obs dispatch
 counters) — if it disagrees with the rung label the result is marked
 degraded + kernel_mismatch so decide_flips.py refuses to compare it.
@@ -225,6 +230,97 @@ def _leaves_sweep(params, n_rows, n_feat, sparsity):
             "marginal_ms_per_leaf": round(marginal, 3)}
 
 
+def _serving_rung(booster, n_feat, sparsity):
+    """High-QPS serving micro-rung (docs/SERVING.md): p50/p99 latency and
+    QPS of the SoA microbatch engine at 1/64/4096-row batches on the model
+    this child just trained, the speedup over the per-tree
+    ``Predictor.predict`` host loop, and a mixed-size request replay
+    through the async ModelServer pinned to ZERO recompiles via the
+    ``predict_jit_entries`` gauge.  Default-on for the cpu rung,
+    BENCH_SERVING=1 forces it on tpu, =0 disables."""
+    import time
+
+    import numpy as np
+    from lightgbm_tpu.inference import jit_entries
+    from lightgbm_tpu.obs.counters import counters as obs_counters
+    from lightgbm_tpu.serving import ModelServer
+
+    X, _ = make_data(8192, n_feat, sparsity, seed=7)
+    X = np.asarray(X, np.float64)
+    # the engine exactly as serving would build it ('auto' backend:
+    # SoA microbatch executables on an accelerator, the OpenMP C++
+    # traversal on a bare-CPU backend) plus a forced-xla twin so the
+    # jitted path is measured on every tier
+    auto_eng = booster.predict_engine(prewarm=True)
+    from lightgbm_tpu.inference import PredictEngine
+    xla_eng = auto_eng if auto_eng.backend == "xla" else \
+        PredictEngine(booster.models, booster.num_class,
+                      prewarm=True, backend="xla")
+    entries_warm = jit_entries()
+    p = booster.predictor()            # engine attached (just built)
+
+    # the displaced baseline: the per-tree host-traversal loop the
+    # acceptance bar prices the engine against
+    x4 = X[:4096]
+    t0 = time.perf_counter()
+    p.predict_raw_trees(x4)
+    old_s = time.perf_counter() - t0
+
+    out = {"predict_jit_entries": entries_warm,
+           "backend": auto_eng.backend, "backends": {}}
+    engines = {auto_eng.backend: auto_eng}
+    if xla_eng is not auto_eng:
+        engines["xla"] = xla_eng
+    for name, eng in engines.items():
+        buckets = {}
+        for b, reps in ((1, 50), (64, 30), (4096, 5)):
+            xb = X[:b]
+            eng.raw_scores(xb)         # touch (compiled at prewarm)
+            lats = []
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                t1 = time.perf_counter()
+                eng.raw_scores(xb)
+                lats.append((time.perf_counter() - t1) * 1e3)
+            total = time.perf_counter() - t0
+            lats = np.asarray(lats)
+            buckets[str(b)] = {
+                "p50_ms": round(float(np.percentile(lats, 50)), 3),
+                "p99_ms": round(float(np.percentile(lats, 99)), 3),
+                "qps": round(reps * b / total, 1),
+            }
+        out["backends"][name] = {
+            "buckets": buckets,
+            "speedup_vs_predict_loop": round(
+                buckets["4096"]["qps"] / (4096 / old_s), 2)}
+    out["buckets"] = out["backends"][auto_eng.backend]["buckets"]
+    out["predict_loop_rows_per_s"] = round(4096 / old_s, 1)
+    out["speedup_vs_predict_loop"] = \
+        out["backends"][auto_eng.backend]["speedup_vs_predict_loop"]
+
+    # mixed-size replay, twice: through the async server (coalescing, as
+    # deployed) and against the forced-xla ladder — the recompile pin
+    # must hold on the JITTED path, not just on a backend that never
+    # compiles
+    rng = np.random.RandomState(3)
+    sizes = rng.choice([1, 2, 8, 33, 64, 200, 512, 1111, 4096], size=60)
+    for s in sizes:
+        xla_eng.raw_scores(X[:int(s)])
+    srv = ModelServer(booster=booster,
+                      params={"verbose": -1, "latency_budget_ms": 1.0})
+    futs = [srv.submit(X[:int(s)]) for s in sizes]
+    for f in futs:
+        f.result(timeout=300)
+    rep = srv.stop()
+    out["replay"] = {"requests": rep["requests"], "rows": rep["rows"],
+                     "batches": rep["batches"], "qps": rep["qps"],
+                     "rows_per_s": rep["rows_per_s"]}
+    out["recompiles"] = jit_entries() - entries_warm
+    out["zero_recompile"] = out["recompiles"] == 0
+    obs_counters.gauge("predict_jit_entries", jit_entries())
+    return out
+
+
 def child_main():
     """The measured workload.  Runs under BENCH_CHILD with the platform and
     histogram method fixed by the supervisor; prints the result JSON line."""
@@ -379,6 +475,18 @@ def child_main():
         except Exception as e:       # the micro-rung never kills the bench
             leaves_sweep = {"error": str(e)[:200]}
 
+    # serving micro-rung (docs/SERVING.md): engine latency/QPS ladder +
+    # zero-recompile replay on the freshly trained model.  Default on for
+    # the cpu rung like the leaves sweep; BENCH_SERVING=1 forces on tpu
+    serving_flag = os.environ.get("BENCH_SERVING", "")
+    serving = None
+    if serving_flag != "0" and (platform == "cpu" or serving_flag == "1"):
+        try:
+            serving = _serving_rung(booster, n_feat, sparsity)
+            sys.stderr.write(f"bench: serving {json.dumps(serving)}\n")
+        except Exception as e:       # the micro-rung never kills the bench
+            serving = {"error": str(e)[:200]}
+
     trace_file = obs_trace.stop() if bench_trace else None
     telemetry = {
         "observed_kernel": observed,
@@ -417,6 +525,8 @@ def child_main():
     }
     if leaves_sweep is not None:
         result["leaves_sweep"] = leaves_sweep
+    if serving is not None:
+        result["serving"] = serving
     if kernel_mismatch:
         result["kernel_mismatch"] = True
         result["degraded"] = (f"kernel identity mismatch: rung label "

@@ -735,7 +735,7 @@ class Booster:
         """LGBM_BoosterSetLeafValue analogue: overwrite one leaf's raw
         output (same tree numbering as get_leaf_output)."""
         self.inner.models[tree_id].leaf_value[leaf_id] = float(value)
-        self.inner._native_pred = None   # serving cache now stale
+        self.inner._drop_serving_caches()   # serving caches now stale
         return self
 
     def merge(self, other: "Booster") -> "Booster":
@@ -823,6 +823,14 @@ class Booster:
             pred_early_stop_freq=None if es_freq is None else int(es_freq),
             pred_early_stop_margin=(None if es_margin is None
                                     else float(es_margin)))
+
+    def predict_engine(self, prewarm: bool = True, buckets=None):
+        """Build (or return the cached) SoA serving engine for this model
+        — the flatten + device threshold tables + pre-warmed microbatch
+        executables of docs/SERVING.md.  Called once at model
+        load/finalize by the serving loop; subsequent ``predict`` calls
+        reuse it through the cached :class:`Predictor` engine."""
+        return self.inner.predict_engine(prewarm=prewarm, buckets=buckets)
 
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
         if num_iteration is None or num_iteration <= 0:

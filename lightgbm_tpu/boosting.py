@@ -96,6 +96,13 @@ class GBDT:
         self._nf_event_iter: Optional[int] = None
         self._nf_rolled_iter: Optional[int] = None
         self._score_stash = None   # (iter, scores, [valid scores]) refs
+        # serving caches, both invalidated together whenever the stored
+        # trees change other than by appending (rollback, merge, DART
+        # normalize, leaf edits): the native C++ predictor and the SoA
+        # microbatch engine (lightgbm_tpu.inference / docs/SERVING.md)
+        self._native_pred = None
+        self._pred_engine = None
+        self._pred_engine_ntrees = -1
         self.models: List[Tree] = []
         self.timers = PhaseTimers()   # TIMETAG analogue (gbdt.cpp:22-64)
         self.iter_ = 0
@@ -973,7 +980,7 @@ class GBDT:
         subtract-the-contribution arithmetic, exact up to f32 rounding."""
         if self.iter_ <= 0:
             return
-        self._native_pred = None   # model-length alone can't detect this
+        self._drop_serving_caches()  # model length alone can't detect this
         if self._stash_usable(self.iter_ - 1):
             for _ in range(self.num_class):
                 self.models.pop()
@@ -1021,7 +1028,7 @@ class GBDT:
                     "rollback — the source is not transient; fix the "
                     "objective/data or use nonfinite_policy=clamp")
             self._nf_rolled_iter = it
-            self._native_pred = None
+            self._drop_serving_caches()
             # unwind this iteration's already-stored earlier classes:
             # restore the iteration-start score references (bit-exact) and
             # drop their trees; arithmetic revert is the fallback
@@ -1139,7 +1146,7 @@ class GBDT:
         self._stopped_no_split = False
         self._iter_had_split = False
         self._score_stash = None
-        self._native_pred = None
+        self._drop_serving_caches()
 
     # ------------------------------------------------------------------- eval
 
@@ -1168,11 +1175,44 @@ class GBDT:
 
     # ---------------------------------------------------------------- predict
 
+    def _drop_serving_caches(self) -> None:
+        """Invalidate every derived serving artifact.  Appending trees is
+        detected by length (the cheap common case during training); any
+        other mutation of the stored trees must call this."""
+        self._native_pred = None
+        self._pred_engine = None
+        self._pred_engine_ntrees = -1
+
+    def predict_engine(self, prewarm: bool = False, buckets=None,
+                       build: bool = True, backend: str = "auto"):
+        """The cached SoA serving engine for the current model
+        (lightgbm_tpu.inference.PredictEngine; docs/SERVING.md).  Built at
+        most once per model state: the flatten + threshold tables are
+        reused across every subsequent predict/serving call, and appended
+        trees (continued training) rebuild automatically.  ``build=False``
+        only returns an engine that is already fresh."""
+        fresh = (self._pred_engine is not None
+                 and self._pred_engine_ntrees == len(self.models))
+        if not fresh:
+            if not build:
+                return None
+            from .inference import PredictEngine
+            kw = {} if buckets is None else {"buckets": buckets}
+            self._pred_engine = PredictEngine(
+                self.models, self.num_class, prewarm=prewarm,
+                backend=backend, model_str=self.save_model_to_string(),
+                **kw)
+            self._pred_engine_ntrees = len(self.models)
+        elif prewarm and not self._pred_engine._warmed:
+            self._pred_engine.prewarm()
+        return self._pred_engine
+
     def predictor(self, num_iteration: int = -1, raw_score: bool = False,
                   pred_early_stop: bool = False,
                   pred_early_stop_freq: Optional[int] = None,
                   pred_early_stop_margin: Optional[float] = None) -> Predictor:
         return Predictor(self.models, self.num_class, self.objective,
+                         engine=self.predict_engine(build=False),
                          average_output=self.average_output,
                          num_iteration=(num_iteration + (1 if (
                              self.boost_from_average_ and num_iteration > 0)
@@ -1246,7 +1286,7 @@ class GBDT:
         merged = [copy.deepcopy(t) for t in other.models]
         self.num_init_iteration += len(merged) // max(self.num_class, 1)
         self.models = merged + self.models
-        self._native_pred = None
+        self._drop_serving_caches()
 
     # ------------------------------------------------------------- model file
 
@@ -1491,7 +1531,7 @@ class DART(GBDT):
         pairs = [(i, c) for i in self._drop_index
                  for c in range(self.num_class)]
         dropped = [self.models[self._model_index(i, c)] for i, c in pairs]
-        self._native_pred = None   # in-place shrink invalidates the cache
+        self._drop_serving_caches()  # in-place shrink stales both caches
         # one batched traversal per valid set for ALL dropped trees
         valid_contribs = [self._trees_scores(dropped, vs.bins)
                           for vs in self.valid_sets]

@@ -285,6 +285,29 @@ class Config:
                                      # output_model like snapshot_freq ones
                                      # and resume with snapshot_resume.
 
+    # serving (docs/SERVING.md): the high-QPS batched prediction engine
+    latency_budget_ms: float = 2.0   # serving microbatcher coalescing
+                                     # window: a dispatched request waits
+                                     # at most this long for companions
+                                     # before its microbatch runs (0 =
+                                     # dispatch immediately, no
+                                     # coalescing)
+    serving_buckets: str = "1,8,64,512,4096"  # ascending microbatch row
+                                     # ladder; every request batch is
+                                     # padded up to the next bucket so the
+                                     # predict executable set stays
+                                     # bounded and pre-warmed
+                                     # (predict_jit_entries gauge)
+    model_watch: str = ""            # hot model swap: checkpoint prefix
+                                     # (a trainer's output_model) whose
+                                     # committed snapshots/manifests the
+                                     # server watches; a newly committed
+                                     # iteration is loaded, pre-warmed off
+                                     # the serving path, and swapped in
+                                     # atomically between microbatches
+                                     # ("" = no watching)
+    model_watch_interval: float = 1.0  # seconds between model_watch polls
+
     # distributed (reference NetworkConfig -> JAX mesh knobs)
     num_machines: int = 1
     local_listen_port: int = 12400
@@ -551,6 +574,16 @@ def check_param_conflicts(cfg: Config) -> None:
         log.fatal("hang_timeout (%g s) must exceed heartbeat_interval "
                   "(%g s): every rank would look hung between two stamps",
                   cfg.hang_timeout, cfg.heartbeat_interval)
+    if cfg.latency_budget_ms < 0:
+        log.fatal("latency_budget_ms must be >= 0 (0 = dispatch "
+                  "immediately); got %r", cfg.latency_budget_ms)
+    if cfg.model_watch_interval <= 0:
+        log.fatal("model_watch_interval must be positive seconds; got %r",
+                  cfg.model_watch_interval)
+    try:
+        parse_serving_buckets(cfg.serving_buckets)
+    except ValueError as e:
+        log.fatal("%s", e)
     if cfg.restart_limit < 0:
         log.fatal("restart_limit must be >= 0; got %d", cfg.restart_limit)
     if cfg.restart_backoff < 0:
@@ -574,6 +607,24 @@ def check_param_conflicts(cfg: Config) -> None:
             log.fatal("pallas_hist_impl=nibble needs pallas_feat_tile*16 "
                       "divisible by 128 (got pallas_feat_tile=%d)",
                       cfg.pallas_feat_tile)
+
+
+def parse_serving_buckets(spec) -> tuple:
+    """``serving_buckets`` ("1,8,64,512,4096") -> ascending int tuple;
+    raises ValueError on empty/non-positive/non-ascending specs so config
+    parsing fails with the real cause (docs/SERVING.md)."""
+    if isinstance(spec, (tuple, list)):
+        vals = [int(v) for v in spec]
+    else:
+        vals = [int(v) for v in str(spec).replace(",", " ").split()]
+    if not vals:
+        raise ValueError("serving_buckets must name at least one batch size")
+    if any(v <= 0 for v in vals):
+        raise ValueError(f"serving_buckets must be positive; got {vals}")
+    if sorted(vals) != vals or len(set(vals)) != len(vals):
+        raise ValueError(
+            f"serving_buckets must be strictly ascending; got {vals}")
+    return tuple(vals)
 
 
 def parse_config_file(path: str) -> Dict[str, str]:

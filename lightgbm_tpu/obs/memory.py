@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import json
 import weakref
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .counters import counters
 
@@ -314,7 +314,10 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
                 num_class: int = 1, bin_bytes: Optional[int] = None,
                 packed_cols: int = 0, valid_rows: int = 0,
                 ordered_bins: bool = False, gather_words: bool = False,
-                bucket_min_log2: int = 6) -> Dict[str, Any]:
+                bucket_min_log2: int = 6, serving_trees: int = 0,
+                serving_nodes: int = 0, serving_cols: int = 0,
+                serving_bins: int = 0,
+                serving_buckets: Sequence[int] = ()) -> Dict[str, Any]:
     """Analytic device-memory model of one training (the codified
     ``docs/MEMORY.md`` audit; that doc's table is generated from this
     function by ``scripts/gen_memory_doc.py``).
@@ -367,6 +370,20 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
         "ordered_copies": ((rows + maxbuf) * row_bytes
                            if ordered_bins else 0),
     }
+    if serving_trees > 0:
+        # the serving engine's term (docs/SERVING.md): resident SoA node
+        # arrays [Tp, P] (feat/thr/left/right i32 + miss/cat_ref i32 +
+        # default_left/is_cat bool = 26 B/node) + the per-column bin
+        # threshold tables; transient per-bucket microbatch buffers (raw
+        # f32 input + bins/cats i32 + nan/zero masks + per-tree
+        # node/leaf/output state), summed over the ladder — pessimistic
+        # by design, a pre-flight bound, since at most one bucket is in
+        # flight per engine at a time
+        residents["serving_model"] = (serving_trees * serving_nodes * 26
+                                      + serving_cols * serving_bins * 4)
+        transients["serving_batches"] = sum(
+            b * (serving_cols * 14 + serving_trees * 12)
+            for b in serving_buckets)
     resident_bytes = sum(residents.values())
     transient_bytes = sum(transients.values())
     return {

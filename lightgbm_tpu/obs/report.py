@@ -187,6 +187,57 @@ def _memory_lines(snap: dict) -> List[str]:
     return lines
 
 
+def _serving_lines(events: List[dict],
+                   counters: Dict[str, Dict[str, float]],
+                   gauges: Dict[str, Any]) -> List[str]:
+    """The report's Serving section: predict-executable dispatch identity
+    (batch bucket + executable tag), the ``predict_jit_entries`` recompile
+    gauge, and the server's per-bucket latency histograms/percentiles
+    (the ``serving stats`` summary the ModelServer flushes at stop)."""
+    dispatch = counters.get("predict_dispatch", {})
+    stats = summary_payload(events, "serving stats")
+    jit_gauge = {k: v for k, v in gauges.items()
+                 if k.endswith("predict_jit_entries")}
+    if not (dispatch or stats):
+        return []
+    lines = ["", "## Serving / predict", ""]
+    for k, v in sorted(jit_gauge.items()):
+        lines.append(f"- `{k}` = {int(v)} compiled microbatch signature(s)")
+    if dispatch:
+        lines += ["", "Microbatch dispatches by (bucket, input path, "
+                      "executable identity) — a warmed ladder must only "
+                      "ever reuse these signatures:", ""]
+        rows = []
+        for key, v in sorted(dispatch.items(),
+                             key=lambda kv: int(_split_tags(kv[0])
+                                               .get("bucket", 0))):
+            t = _split_tags(key)
+            rows.append([t.get("bucket", "?"), t.get("path", "?"),
+                         t.get("exec", "?"), int(v)])
+        lines += _md_table(["bucket", "path", "executable", "dispatches"],
+                           rows)
+    if stats:
+        lines += ["", f"Server totals: {stats.get('requests', 0)} requests "
+                      f"/ {stats.get('rows', 0)} rows in "
+                      f"{stats.get('batches', 0)} coalesced batches, "
+                      f"{stats.get('qps', 0)} req/s, "
+                      f"{stats.get('rows_per_s', 0)} rows/s, "
+                      f"{stats.get('swaps', 0)} hot swap(s).", ""]
+        rows = []
+        hist_keys: List[str] = []
+        for b, s in sorted(stats.get("buckets", {}).items(),
+                           key=lambda kv: int(kv[0])):
+            if not hist_keys:
+                hist_keys = list(s.get("hist", {}))
+            rows.append([b, s.get("count"), s.get("p50_ms"),
+                         s.get("p99_ms"), s.get("max_ms")]
+                        + [s.get("hist", {}).get(h, 0) for h in hist_keys])
+        if rows:
+            lines += _md_table(["bucket", "requests", "p50 ms", "p99 ms",
+                                "max ms"] + hist_keys, rows)
+    return lines
+
+
 def render(path) -> str:
     paths = [path] if isinstance(path, str) else list(path)
     ranked = load_events_ranked(paths)
@@ -280,6 +331,7 @@ def render(path) -> str:
             ["op", "site", "bytes"],
             [[_split_tags(k).get("op", "?"), _split_tags(k).get("site", "-"),
               int(v)] for k, v in sorted(coll.items())])
+    lines += _serving_lines(events, counters, snap.get("gauges", {}))
     lines += _memory_lines(snap)
     events_list = snap.get("events", [])
     if events_list:

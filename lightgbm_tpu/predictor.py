@@ -160,13 +160,21 @@ def trees_scores_binned(bins: jnp.ndarray, trees: List[Tree],
 
 
 class Predictor:
-    """Host batch predictor over a trained model (list of Trees)."""
+    """Host batch predictor over a trained model (list of Trees).
+
+    ``engine`` (a :class:`lightgbm_tpu.inference.PredictEngine`) attaches
+    the cached serving artifact — device-resident SoA node arrays + bin
+    threshold tables flattened once at model load — and ``predict_raw`` /
+    ``predict_leaf_index`` reuse it instead of re-walking the Python tree
+    list per call.  Outputs are bit-identical to the per-tree host loop
+    (:meth:`predict_raw_trees`, kept as the oracle and the early-stop
+    path); see docs/SERVING.md."""
 
     def __init__(self, trees: List[Tree], num_tree_per_iteration: int,
                  objective=None, average_output: bool = False,
                  num_iteration: int = -1,
                  early_stop: bool = False, early_stop_freq: int = 10,
-                 early_stop_margin: float = 10.0):
+                 early_stop_margin: float = 10.0, engine=None):
         self.trees = trees
         self.k = max(num_tree_per_iteration, 1)
         self.objective = objective
@@ -179,9 +187,27 @@ class Predictor:
         self.early_stop = early_stop
         self.early_stop_freq = max(early_stop_freq, 1)
         self.early_stop_margin = early_stop_margin
+        self.engine = engine
+
+    def attach_engine(self, prewarm: bool = False) -> "Predictor":
+        """Build (or reuse) the SoA serving engine for this tree list."""
+        if self.engine is None:
+            from .inference import PredictEngine
+            self.engine = PredictEngine(self.trees, self.k, prewarm=prewarm)
+        return self
 
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
-        """Raw margin scores [K, N]."""
+        """Raw margin scores [K, N]; routed through the attached serving
+        engine when one exists (bit-identical, pinned)."""
+        if self.engine is not None and not self.early_stop:
+            return self.engine.raw_scores(X,
+                                          num_trees=self.num_iteration * self.k)
+        return self.predict_raw_trees(X)
+
+    def predict_raw_trees(self, X: np.ndarray) -> np.ndarray:
+        """The per-tree host traversal loop — the bit-exactness oracle the
+        engine path is pinned against, and the only implementation of
+        margin-based early stopping."""
         X = np.atleast_2d(np.asarray(X, dtype=np.float64))
         n = X.shape[0]
         out = np.zeros((self.k, n), dtype=np.float64)
@@ -212,7 +238,13 @@ class Predictor:
         return srt[-1] - srt[-2]
 
     def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
-        out = self.predict_raw(X)
+        return self._transform(self.predict_raw(X), raw_score)
+
+    def _transform(self, out: np.ndarray,
+                   raw_score: bool = False) -> np.ndarray:
+        """Margin [K, N] -> user-facing output (also the serving loop's
+        per-request post-processing, so coalesced raw and transformed
+        requests share one traversal)."""
         if not raw_score:
             # GBDT::Predict (gbdt_prediction.cpp:29-38): average_output
             # (RF) divides by the iteration count and does NOT apply the
@@ -228,9 +260,14 @@ class Predictor:
         return out.T  # [N, K] like the reference python package
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        total = self.num_iteration * self.k
+        if self.engine is not None:
+            # leaf indices are integers: engine routing is identical by
+            # construction, so this is the same output without T host walks
+            return np.ascontiguousarray(
+                self.engine.leaves(X)[:total].T.astype(np.int32))
         X = np.atleast_2d(np.asarray(X, dtype=np.float64))
         n = X.shape[0]
-        total = self.num_iteration * self.k
         out = np.zeros((n, total), dtype=np.int32)
         for i in range(total):
             out[:, i] = self.trees[i].predict_leaf_index(X)

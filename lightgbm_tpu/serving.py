@@ -1,0 +1,480 @@
+"""Async high-QPS serving loop: request coalescing, microbatch dispatch,
+hot model swap.  ``python -m lightgbm_tpu.serving`` is the CLI.
+
+The host side of the serving path (docs/SERVING.md; the device side is
+:mod:`lightgbm_tpu.inference`):
+
+* **Latency-budget batching** — concurrent requests land in one queue; a
+  single dispatcher thread coalesces them into the largest
+  ``serving_buckets`` ladder bucket reachable within ``latency_budget_ms``
+  of the oldest waiting request, then runs ONE microbatch executable for
+  the whole coalition.  Each request's rows stay contiguous, so a request
+  is always answered by exactly one model — there is no torn read by
+  construction.
+* **Hot model swap** — with ``model_watch`` set, a watcher thread polls
+  the checkpoint commit point of PR 6
+  (:func:`lightgbm_tpu.checkpoint.latest_committed_iteration`: plain
+  snapshots, or shard sets whose rank-0 manifest validates) and, when a
+  trainer commits a newer iteration, loads the model, builds + pre-warms
+  its engine OFF the serving path, and swaps it in atomically between
+  microbatches.  In-flight microbatches hold a reference to the old
+  engine and complete on it; the next dispatch uses the new one.  A
+  same-bucket-shape swap reuses the compiled executables (zero
+  recompiles — the kernels take every model array as an argument).
+* **Observability** — every dispatch is an obs span + a
+  ``predict_dispatch`` counter; the server keeps per-bucket latency
+  reservoirs whose p50/p99/QPS summary lands in :meth:`ModelServer.stats`,
+  as a ``serving stats`` telemetry summary in the trace file (rendered by
+  ``python -m lightgbm_tpu.obs``), and in the bench JSON ``serving`` rung.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import checkpoint as checkpoint_mod
+from .config import config_from_params, parse_serving_buckets
+from .obs import trace as obs_trace
+from .obs.counters import counters as obs_counters
+from .utils import log
+
+# per-bucket latency histogram edges (ms) for the obs report
+_HIST_EDGES_MS = (0.5, 1, 2, 5, 10, 20, 50, 100, 500)
+
+
+class _Request:
+    __slots__ = ("x", "future", "t_enq", "raw_score", "n")
+
+    def __init__(self, x: np.ndarray, raw_score: bool):
+        self.x = x
+        self.n = x.shape[0]
+        self.raw_score = raw_score
+        self.future: Future = Future()
+        self.t_enq = time.perf_counter()
+
+
+class ServingStats:
+    """Per-bucket latency reservoirs + throughput counters (thread-safe)."""
+
+    RESERVOIR = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lat: Dict[int, collections.deque] = {}
+        self._requests = 0
+        self._rows = 0
+        self._batches = 0
+        self._swaps = 0
+        self._t0 = time.perf_counter()
+
+    def record_batch(self, bucket: int, request_latencies_ms: List[float],
+                     rows: int) -> None:
+        with self._lock:
+            d = self._lat.setdefault(bucket,
+                                     collections.deque(maxlen=self.RESERVOIR))
+            d.extend(request_latencies_ms)
+            self._requests += len(request_latencies_ms)
+            self._rows += rows
+            self._batches += 1
+
+    def record_swap(self) -> None:
+        with self._lock:
+            self._swaps += 1
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            elapsed = max(time.perf_counter() - self._t0, 1e-9)
+            buckets = {}
+            for b, d in sorted(self._lat.items()):
+                lat = np.asarray(d, np.float64)
+                hist = {}
+                lo = 0.0
+                for edge in _HIST_EDGES_MS:
+                    hist[f"<={edge}ms"] = int(((lat > lo)
+                                               & (lat <= edge)).sum()
+                                              + (lo == 0.0) * (lat == 0).sum())
+                    lo = edge
+                hist[f">{_HIST_EDGES_MS[-1]}ms"] = int(
+                    (lat > _HIST_EDGES_MS[-1]).sum())
+                buckets[str(b)] = {
+                    "count": int(len(lat)),
+                    "p50_ms": round(float(np.percentile(lat, 50)), 3),
+                    "p99_ms": round(float(np.percentile(lat, 99)), 3),
+                    "max_ms": round(float(lat.max()), 3),
+                    "hist": hist,
+                }
+            return {"requests": self._requests, "rows": self._rows,
+                    "batches": self._batches, "swaps": self._swaps,
+                    "elapsed_s": round(elapsed, 3),
+                    "qps": round(self._requests / elapsed, 2),
+                    "rows_per_s": round(self._rows / elapsed, 1),
+                    "buckets": buckets}
+
+
+class ModelServer:
+    """Queue + dispatcher + (optional) model watcher around one
+    :class:`~lightgbm_tpu.inference.PredictEngine`.
+
+    ``submit`` is the async API (returns a Future), ``predict`` the
+    blocking convenience.  ``start()``/``stop()`` run the threads;
+    constructing with ``autostart=False`` and enqueueing before
+    ``start()`` makes coalescing deterministic (the tests use this)."""
+
+    def __init__(self, booster=None, model_file: Optional[str] = None,
+                 model_str: Optional[str] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 prewarm: bool = True, autostart: bool = True):
+        from .basic import Booster
+        self.params = dict(params or {})
+        cfg = config_from_params(
+            {k: v for k, v in self.params.items()})
+        self.latency_budget_s = float(cfg.latency_budget_ms) / 1e3
+        self.buckets = parse_serving_buckets(cfg.serving_buckets)
+        self.watch_prefix = str(cfg.model_watch or "")
+        self.watch_interval = float(cfg.model_watch_interval)
+        if booster is None and model_file is None and model_str is None \
+                and not self.watch_prefix:
+            raise ValueError("ModelServer needs a booster, model_file, "
+                             "model_str, or model_watch prefix")
+        if booster is None and (model_file or model_str):
+            booster = Booster(params=self.params, model_file=model_file,
+                              model_str=model_str)
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._booster = None
+        self._predictor = None
+        self._engine = None
+        self.loaded_iteration: Optional[int] = None
+        self.stats_ = ServingStats()
+        self._running = False
+        self._threads: List[threading.Thread] = []
+        if booster is not None:
+            self._install(booster, iteration=None, prewarm=prewarm)
+        elif self.watch_prefix:
+            # watch-only start: block until the trainer commits anything
+            if not self._poll_model_watch(prewarm=prewarm):
+                log.warning("model_watch: no committed checkpoint under %s "
+                            "yet; serving starts after the first commit",
+                            self.watch_prefix)
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------- install
+
+    def _install(self, booster, iteration: Optional[int],
+                 prewarm: bool) -> None:
+        """Build engine + predictor for ``booster`` and swap them in
+        atomically.  Everything expensive (flatten, compile warmup) runs
+        BEFORE the swap — the dispatcher never blocks on it."""
+        gbdt = getattr(booster, "inner", booster)
+        engine = gbdt.predict_engine(prewarm=prewarm, buckets=self.buckets)
+        predictor = gbdt.predictor()
+        with self._lock:
+            first = self._predictor is None
+            self._booster = booster
+            self._engine = engine
+            self._predictor = predictor
+            self.loaded_iteration = iteration
+        if not first:
+            self.stats_.record_swap()
+            obs_counters.inc("serving_model_swap")
+        obs_counters.event("model_swap" if not first else "model_load",
+                           iteration=iteration,
+                           trees=engine.bundle.num_trees,
+                           exec=engine.bundle.exec_id())
+        log.info("serving: %s model%s (%d trees, exec %s)",
+                 "swapped in" if not first else "loaded",
+                 f" at iteration {iteration}" if iteration is not None
+                 else "", engine.bundle.num_trees, engine.bundle.exec_id())
+
+    def _poll_model_watch(self, prewarm: bool = True) -> bool:
+        """One watcher step: load + install a newer committed checkpoint
+        if the trainer published one.  Returns True when a swap (or the
+        initial load) happened."""
+        from .boosting import GBDT
+        it = checkpoint_mod.latest_committed_iteration(self.watch_prefix)
+        if it is None or it == self.loaded_iteration:
+            return False
+        plain = checkpoint_mod.snapshot_path(self.watch_prefix, it)
+        if not os.path.exists(plain):
+            # a coordinated shard set: rank 0's shard carries the model
+            # text, the manifest is the commit point that admitted it
+            plain = checkpoint_mod.shard_path(self.watch_prefix, it, 0)
+        try:
+            model_str, _ = checkpoint_mod.load_snapshot(plain)
+            gbdt = GBDT.load_from_string(model_str,
+                                         config_from_params(self.params))
+        except (checkpoint_mod.CheckpointError, OSError, ValueError) as e:
+            # a commit that validates at the manifest but fails to load is
+            # surfaced, never served
+            obs_counters.event("model_swap_failed", iteration=it,
+                               reason=str(e)[:200])
+            log.warning("model_watch: checkpoint at iteration %s failed to "
+                        "load (%s); keeping the current model", it, e)
+            return False
+        self._install(gbdt, iteration=it, prewarm=prewarm)
+        return True
+
+    def _watch_loop(self) -> None:
+        while self._running:
+            time.sleep(self.watch_interval)
+            if not self._running:
+                return
+            try:
+                self._poll_model_watch()
+            except Exception as e:   # watcher must never die silently
+                obs_counters.event("model_swap_failed", iteration=None,
+                                   reason=str(e)[:200])
+                log.warning("model_watch poll failed: %s", e)
+
+    # ------------------------------------------------------------ requests
+
+    def submit(self, X, raw_score: bool = False) -> Future:
+        x = np.atleast_2d(np.asarray(X, np.float64))
+        req = _Request(x, raw_score)
+        self._queue.put(req)
+        return req.future
+
+    def predict(self, X, raw_score: bool = False):
+        return self.submit(X, raw_score).result()
+
+    def stats(self) -> Dict[str, Any]:
+        s = self.stats_.summary()
+        s["loaded_iteration"] = self.loaded_iteration
+        s["predict_jit_entries"] = _jit_entries_gauge()
+        return s
+
+    # ---------------------------------------------------------- dispatcher
+
+    def _collect(self) -> Optional[List[_Request]]:
+        """Block for the next request, then coalesce companions until the
+        ladder's largest bucket is filled or ``latency_budget_ms`` from
+        the FIRST queued request has elapsed."""
+        try:
+            first = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return None
+        batch = [first]
+        rows = first.n
+        deadline = first.t_enq + self.latency_budget_s
+        max_rows = self.buckets[-1]
+        while rows < max_rows:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            batch.append(nxt)
+            rows += nxt.n
+        return batch
+
+    def _serve_batch(self, batch: List[_Request], predictor) -> None:
+        """Run one coalesced microbatch on a model SNAPSHOT (grabbed by
+        the caller before any swap could land): every request in the
+        coalition is answered by that one model."""
+        rows = sum(r.n for r in batch)
+        tracer = obs_trace.get_tracer()
+        with tracer.span("serving_batch", requests=len(batch), rows=rows):
+            x = batch[0].x if len(batch) == 1 else \
+                np.concatenate([r.x for r in batch], axis=0)
+            # raw and transformed coalesce together: predict() is a pure
+            # host transform of predict_raw's margins
+            raw = predictor.predict_raw(x)
+            done_t = time.perf_counter()
+            lo = 0
+            lats = []
+            for r in batch:
+                sl = raw[:, lo:lo + r.n]
+                lo += r.n
+                try:
+                    r.future.set_result(
+                        predictor._transform(sl, raw_score=r.raw_score))
+                except Exception as e:      # pragma: no cover - transform bug
+                    r.future.set_exception(e)
+                lats.append((done_t - r.t_enq) * 1e3)
+        bucket = next((b for b in self.buckets if rows <= b),
+                      self.buckets[-1])
+        self.stats_.record_batch(bucket, lats, rows)
+        obs_counters.inc("serving_requests", len(batch))
+        obs_counters.inc("serving_batches", bucket=bucket)
+
+    def _dispatch_loop(self) -> None:
+        while self._running:
+            batch = self._collect()
+            if batch is None:
+                continue
+            with self._lock:          # model snapshot for this coalition
+                predictor = self._predictor
+            if predictor is None:
+                for r in batch:
+                    r.future.set_exception(
+                        RuntimeError("no model loaded yet (model_watch saw "
+                                     "no committed checkpoint)"))
+                continue
+            try:
+                self._serve_batch(batch, predictor)
+            except Exception as e:
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ModelServer":
+        if self._running:
+            return self
+        self._running = True
+        t = threading.Thread(target=self._dispatch_loop,
+                             name="lgbm-serving-dispatch", daemon=True)
+        t.start()
+        self._threads = [t]
+        if self.watch_prefix:
+            w = threading.Thread(target=self._watch_loop,
+                                 name="lgbm-serving-watch", daemon=True)
+            w.start()
+            self._threads.append(w)
+        return self
+
+    def stop(self) -> Dict[str, Any]:
+        """Stop threads, flush the ``serving stats`` telemetry summary,
+        return the final stats."""
+        self._running = False
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        s = self.stats()
+        obs_trace.get_tracer().summary("serving stats", s)
+        return s
+
+
+def _jit_entries_gauge() -> int:
+    from .inference import jit_entries
+    n = jit_entries()
+    obs_counters.gauge("predict_jit_entries", n)
+    return n
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def _run_http(server: ModelServer, port: int) -> None:
+    """Minimal stdlib HTTP front: POST /predict {"data": [[...]...]} ->
+    {"predictions": [...]}; GET /stats, GET /healthz."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _json(self, code: int, payload) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path.startswith("/healthz"):
+                self._json(200, {"ok": server._predictor is not None,
+                                 "loaded_iteration":
+                                     server.loaded_iteration})
+            elif self.path.startswith("/stats"):
+                self._json(200, server.stats())
+            else:
+                self._json(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if not self.path.startswith("/predict"):
+                self._json(404, {"error": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                x = np.asarray(body["data"], np.float64)
+                out = server.predict(x, raw_score=bool(
+                    body.get("raw_score", False)))
+                self._json(200, {"predictions": np.asarray(out).tolist()})
+            except Exception as e:
+                self._json(400, {"error": str(e)[:500]})
+
+        def log_message(self, fmt, *args):   # route through our logger
+            log.debug("serving http: " + fmt, *args)
+
+    httpd = ThreadingHTTPServer(("", port), Handler)
+    log.info("serving: HTTP on port %d (POST /predict, GET /stats, "
+             "GET /healthz)", httpd.server_address[1])
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:         # pragma: no cover - interactive
+        pass
+    finally:
+        httpd.server_close()
+
+
+def _run_replay(server: ModelServer, n_requests: int, n_features: int,
+                seed: int = 0) -> Dict[str, Any]:
+    """Synthetic mixed-size request replay against a live server — the
+    zero-recompile / latency smoke the capture playbook collects."""
+    rng = np.random.RandomState(seed)
+    sizes = rng.choice([1, 1, 3, 8, 17, 64, 200, 512, 1500, 4096],
+                       size=n_requests)
+    futures = [server.submit(rng.randn(int(s), n_features))
+               for s in sizes]
+    for f in futures:
+        f.result(timeout=300)
+    return server.stats()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.serving",
+        description="High-QPS model server (docs/SERVING.md)")
+    ap.add_argument("--model", help="model text file to serve")
+    ap.add_argument("--watch", default="",
+                    help="checkpoint prefix (trainer output_model) to hot-"
+                         "swap from (model_watch param)")
+    ap.add_argument("--port", type=int, default=8080,
+                    help="HTTP port (ignored under --replay)")
+    ap.add_argument("--latency-budget-ms", type=float, default=None)
+    ap.add_argument("--buckets", default=None,
+                    help="serving_buckets ladder, e.g. 1,8,64,512,4096")
+    ap.add_argument("--watch-interval", type=float, default=None)
+    ap.add_argument("--replay", type=int, default=0, metavar="N",
+                    help="serve N synthetic mixed-size requests, print the "
+                         "stats JSON, exit")
+    ap.add_argument("--features", type=int, default=28,
+                    help="synthetic replay feature count")
+    args = ap.parse_args(argv)
+    if not args.model and not args.watch:
+        ap.error("need --model and/or --watch")
+    params: Dict[str, Any] = {"verbose": -1}
+    if args.latency_budget_ms is not None:
+        params["latency_budget_ms"] = args.latency_budget_ms
+    if args.buckets:
+        params["serving_buckets"] = args.buckets
+    if args.watch:
+        params["model_watch"] = args.watch
+    if args.watch_interval is not None:
+        params["model_watch_interval"] = args.watch_interval
+    server = ModelServer(model_file=args.model or None, params=params)
+    if args.replay:
+        stats = _run_replay(server, args.replay, args.features)
+        server.stop()
+        print(json.dumps(stats))
+        return 0
+    _run_http(server, args.port)
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -159,6 +159,34 @@ class Tree:
         ci = int(self.threshold[node])
         return self.cat_threshold[self.cat_boundaries[ci]:self.cat_boundaries[ci + 1]]
 
+    def cat_value_mask(self, node: int, width: int) -> np.ndarray:
+        """bool[width]: which raw category VALUES route left at a
+        categorical node — the bitset unpacked (vectorized), used by the
+        serving engine's SoA flatten (lightgbm_tpu.inference).  Values at
+        or beyond the node's bitset stay False, like CategoricalDecision."""
+        bits = np.unpackbits(
+            self.cat_bitset(node).view(np.uint8), bitorder="little")
+        out = np.zeros(width, dtype=bool)
+        n = min(width, len(bits))
+        out[:n] = bits[:n].astype(bool)
+        return out
+
+    def max_depth(self) -> int:
+        """Edges on the longest root->leaf path (0 for stumps) — bounds
+        the traversal loop any flattened evaluator needs."""
+        n = self.num_leaves - 1
+        if n <= 0:
+            return 0
+        depth = np.zeros(n, dtype=np.int64)
+        best = 1
+        for i in range(n):          # parents precede children in this layout
+            for c in (int(self.left_child[i]), int(self.right_child[i])):
+                if c >= 0:
+                    depth[c] = depth[i] + 1
+                else:
+                    best = max(best, int(depth[i]) + 1)
+        return best
+
     def cat_bin_mask(self, node: int, mapper, width: int) -> np.ndarray:
         """bool[width]: which *bins* of the split feature route left at a
         categorical node (inverse of the value bitset, for binned predict)."""

@@ -51,7 +51,8 @@ FLIPS = [
      "bench_sparse.json"),
 ]
 COVERAGE = ["bench_1m_63bin.json", "bench_higgs_full.json",
-            "bench_wide.json", "bench_sparse.json", "bench_leaves.json"]
+            "bench_wide.json", "bench_sparse.json", "bench_leaves.json",
+            "bench_serving.json"]
 
 
 def load(path):
@@ -116,6 +117,23 @@ def memory_row(d):
             f"{f', capacity {cap_b / 1e9:.1f} GB' if cap_b else ''})")
 
 
+def serving_row(d):
+    """One-line serving-rung summary of an artifact's "serving" block
+    (bench.py `_serving_rung`, docs/SERVING.md): chosen backend, the
+    batch-4096 latency/QPS, the speedup over the displaced
+    Predictor.predict host loop, and whether the mixed-size replay held
+    the predict_jit_entries gauge (zero recompiles)."""
+    s = d.get("serving")
+    if not isinstance(s, dict) or "error" in s:
+        return None
+    b4 = (s.get("buckets") or {}).get("4096", {})
+    return (f"serving[{s.get('backend')}]: 4096-row p50 "
+            f"{b4.get('p50_ms')} ms / {b4.get('qps')} rows/s "
+            f"({s.get('speedup_vs_predict_loop')}x the predict loop), "
+            f"{s.get('predict_jit_entries')} jit entries, "
+            f"replay recompiles={s.get('recompiles')}")
+
+
 def main():
     cap = sys.argv[1]
     head = load(os.path.join(cap, "bench_1m.json"))
@@ -131,6 +149,9 @@ def main():
     hm = memory_row(head)
     if hm:
         print(f"{'':10}{hm}")
+    hs = serving_row(head)
+    if hs:
+        print(f"{'':10}{hs}")
     if not deciding:
         print("headline is not a clean TPU number -> NO flip decisions "
               "from this capture; table below is informational only")
@@ -155,6 +176,9 @@ def main():
             mr = memory_row(d)
             if mr:
                 print(f"{'':53}{mr}")
+            sr = serving_row(d)
+            if sr:
+                print(f"{'':53}{sr}")
     for fname, knob, action, base_name in FLIPS:
         d = load(os.path.join(cap, fname))
         if d is None:

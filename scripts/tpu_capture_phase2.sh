@@ -119,6 +119,24 @@ memsnap "leaves"
 snap "leaves sweep"
 
 alive_or_abort "leaves sweep"
+echo "== serving rung (SoA microbatch engine: latency/QPS + recompile pin) ==" \
+    | tee -a "$OUT/log.txt"
+# the high-QPS inference micro-rung (docs/SERVING.md) ON-CHIP: p50/p99 +
+# QPS at 1/64/4096-row batches of the jitted donated-buffer executables
+# against the freshly trained model, the forced-xla ladder alongside the
+# auto backend, and the mixed-size replay's zero-recompile pin
+# (predict_jit_entries) — this window prices on-chip serving next to
+# training for the first time
+BENCH_TRACE="$OUT/trace_serving.jsonl" \
+BENCH_SERVING=1 BENCH_TREES=6 BENCH_STAGE_TIMEOUT=1500 timeout 1800 \
+    python bench.py > "$OUT/bench_serving.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_serving.json" | tee -a "$OUT/log.txt"
+timeout 300 python -m lightgbm_tpu.obs "$OUT/trace_serving.jsonl" \
+    > "$OUT/trace_serving.md" 2>> "$OUT/log.txt" || true
+memsnap "serving"
+snap "serving rung"
+
+alive_or_abort "serving rung"
 echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_ordered_sort.jsonl" \

@@ -1,0 +1,433 @@
+"""Serving path (docs/SERVING.md): SoA inference engine parity, the
+microbatch bucket ladder's zero-recompile pin, the async ModelServer, and
+hot model swap through the checkpoint commit point.
+
+The headline pins:
+
+* engine ``raw_scores`` is BIT-IDENTICAL to the per-tree host loop
+  (``Predictor.predict_raw_trees``) across binary / multiclass K=5 /
+  DART with dropped trees / categorical splits / NaN+default-direction
+  rows, on both input paths (f32-safe device binning, f64 host binning)
+  and both traversal backends (xla, native);
+* a mixed-size request replay over a warmed ladder never moves the
+  ``predict_jit_entries`` gauge;
+* a trainer publishing through the PR 6 checkpoint commit point is
+  picked up by a live server mid-stream with zero failed requests and
+  no torn reads (every response equals exactly one model's output).
+"""
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import checkpoint as checkpoint_mod
+from lightgbm_tpu import native
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import config_from_params, parse_serving_buckets
+from lightgbm_tpu.data.dataset import construct
+from lightgbm_tpu.inference import (DEFAULT_BUCKETS, PredictEngine,
+                                    SoABundle, jit_entries)
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.obs import trace as obs_trace
+from lightgbm_tpu.obs.counters import counters as obs_counters
+from lightgbm_tpu.obs.memory import predict_hbm
+from lightgbm_tpu.obs.report import render
+from lightgbm_tpu.predictor import Predictor
+from lightgbm_tpu.serving import ModelServer
+
+
+def _train(params, X, y, iters, cat=None):
+    cfg = config_from_params(dict(params, verbose=-1))
+    ds = construct(np.asarray(X, np.float64), cfg,
+                   label=np.asarray(y, np.float32),
+                   categorical_features=cat or [])
+    booster = create_boosting(cfg, ds, create_objective(cfg))
+    for _ in range(iters):
+        booster.train_one_iter()
+    return booster
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    rng = np.random.RandomState(7)
+    X = rng.randn(500, 6).astype(np.float32)
+    X[rng.rand(500, 6) < 0.08] = np.nan      # default-direction training
+    y = (np.nansum(X, axis=1) > 0)
+    return _train({"objective": "binary", "num_leaves": 15,
+                   "min_data_in_leaf": 5, "use_missing": True}, X, y, 8)
+
+
+@pytest.fixture(scope="module")
+def test_rows():
+    rng = np.random.RandomState(11)
+    X = rng.randn(137, 6).astype(np.float32).astype(np.float64)
+    X[rng.rand(137, 6) < 0.15] = np.nan
+    return X
+
+
+def _pin_engine_parity(booster, X, backend="xla"):
+    p = Predictor(booster.models, booster.num_class)
+    want = p.predict_raw_trees(X)
+    kw = {"model_str": booster.save_model_to_string()} \
+        if backend == "native" else {}
+    eng = PredictEngine(booster.models, booster.num_class, backend=backend,
+                        **kw)
+    got = eng.raw_scores(X)
+    np.testing.assert_array_equal(want, got)
+    return eng
+
+
+def test_engine_parity_binary_nan_rows(binary_model, test_rows):
+    """f32-representable inputs take the on-device binning path and match
+    the f64 host oracle bit for bit (the floor32 threshold identity)."""
+    eng = _pin_engine_parity(binary_model, test_rows)
+    assert eng.backend == "xla"
+
+
+def test_engine_parity_float64_inputs(binary_model, test_rows):
+    """Values that do not round-trip through f32 are binned on host
+    against the f64 tables — still bit-identical."""
+    rng = np.random.RandomState(3)
+    X = test_rows + 1e-13 * rng.randn(*test_rows.shape)
+    obs_counters.reset()
+    _pin_engine_parity(binary_model, X)
+    paths = {k.split("path=")[1].split(",")[0]
+             for k in obs_counters.get("predict_dispatch")}
+    assert paths == {"binned"}
+
+
+def test_engine_parity_multiclass_k5():
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 8)
+    y = rng.randint(0, 5, 400)
+    booster = _train({"objective": "multiclass", "num_class": 5,
+                      "num_leaves": 8, "min_data_in_leaf": 5}, X, y, 4)
+    Xt = rng.randn(77, 8).astype(np.float32).astype(np.float64)
+    eng = _pin_engine_parity(booster, Xt)
+    assert eng.raw_scores(Xt).shape == (5, 77)
+
+
+def test_engine_parity_dart_dropped_trees():
+    rng = np.random.RandomState(2)
+    X = rng.randn(400, 5)
+    y = (X.sum(axis=1) > 0)
+    booster = _train({"objective": "binary", "boosting_type": "dart",
+                      "num_leaves": 8, "min_data_in_leaf": 5,
+                      "drop_rate": 0.8, "skip_drop": 0.0}, X, y, 10)
+    Xt = rng.randn(60, 5).astype(np.float32).astype(np.float64)
+    _pin_engine_parity(booster, Xt)
+
+
+def test_engine_parity_categorical():
+    rng = np.random.RandomState(4)
+    X = rng.randn(600, 5)
+    X[:, 1] = rng.randint(0, 12, 600)
+    X[:, 3] = rng.randint(0, 40, 600)
+    y = ((X[:, 0] + (X[:, 1] % 3 == 1) - (X[:, 3] % 5 == 2)) > 0)
+    booster = _train({"objective": "binary", "num_leaves": 15,
+                      "min_data_in_leaf": 5}, X, y, 8, cat=[1, 3])
+    assert sum(t.num_cat for t in booster.models) > 0
+    Xt = rng.randn(91, 5)
+    Xt[:, 1] = rng.randint(-1, 14, 91)    # unseen + negative categories
+    Xt[:, 3] = rng.randint(0, 45, 91)
+    Xt[rng.rand(91, 5) < 0.1] = np.nan
+    _pin_engine_parity(booster, Xt)
+
+
+def test_engine_native_backend_parity(binary_model, test_rows):
+    """The 'native' traversal backend (the auto choice on a bare-CPU jax
+    backend) produces the same raw margins as the host loop."""
+    if not native.available():
+        pytest.skip("native library unavailable")
+    eng = _pin_engine_parity(binary_model, test_rows, backend="native")
+    assert eng.backend == "native"
+    # auto on this suite's CPU backend resolves to native too
+    auto = binary_model.predict_engine()
+    assert auto.backend == "native"
+
+
+def test_engine_leaf_index_and_subset_parity(binary_model, test_rows):
+    p_old = Predictor(binary_model.models, binary_model.num_class)
+    p_new = Predictor(binary_model.models, binary_model.num_class,
+                      engine=PredictEngine(binary_model.models,
+                                           binary_model.num_class))
+    np.testing.assert_array_equal(p_old.predict_leaf_index(test_rows),
+                                  p_new.predict_leaf_index(test_rows))
+    for ni in (1, 3):
+        a = Predictor(binary_model.models, 1, num_iteration=ni)
+        b = Predictor(binary_model.models, 1, num_iteration=ni,
+                      engine=p_new.engine)
+        np.testing.assert_array_equal(a.predict_raw_trees(test_rows),
+                                      b.predict_raw(test_rows))
+
+
+def test_bucket_ladder_zero_recompile(binary_model, test_rows):
+    """Pre-warm the ladder, then replay mixed batch sizes: the
+    predict_jit_entries gauge must not move (bounded signature set)."""
+    eng = PredictEngine(binary_model.models, 1, buckets=(1, 8, 64),
+                        prewarm=True)
+    warmed = jit_entries()
+    obs_counters.reset()
+    rng = np.random.RandomState(5)
+    for n in (1, 2, 3, 7, 8, 9, 40, 64, 65, 130, 64, 1):
+        eng.raw_scores(test_rows[rng.randint(0, 137, n)])
+    assert jit_entries() == warmed
+    # dispatch identity: every recorded bucket is on the ladder (above-max
+    # batches run as consecutive max-bucket chunks)
+    buckets = {int(k.split("bucket=")[1].split(",")[0])
+               for k in obs_counters.get("predict_dispatch")}
+    assert buckets <= {1, 8, 64}
+    assert obs_counters.snapshot()["gauges"]["predict_jit_entries"] == warmed
+
+
+def test_engine_cache_reuse_and_invalidation(binary_model, test_rows):
+    eng = binary_model.predict_engine()
+    assert binary_model.predict_engine() is eng           # cached
+    p = binary_model.predictor()
+    assert p.engine is eng                                # attached
+    binary_model.models[0].leaf_value[0] += 0.0           # no-op edit
+    binary_model._drop_serving_caches()
+    assert binary_model.predict_engine() is not eng       # invalidated
+
+
+def test_soa_bundle_shapes(binary_model):
+    b = SoABundle.build(binary_model.models, 1)
+    assert b.tp >= b.num_trees and (b.tp & (b.tp - 1)) == 0
+    assert (b.p & (b.p - 1)) == 0
+    assert b.feat.shape == (b.tp, b.p)
+    assert b.leaf_value.shape == (b.tp, b.p + 1)
+    assert b.exec_id()           # executable identity tag is well-formed
+
+
+def test_serving_buckets_param_validation():
+    assert parse_serving_buckets("1, 8,64") == (1, 8, 64)
+    for bad in ("", "0,4", "8,4", "4,4"):
+        with pytest.raises(ValueError):
+            parse_serving_buckets(bad)
+    with pytest.raises(RuntimeError):
+        config_from_params({"serving_buckets": "8,4"})
+    with pytest.raises(RuntimeError):
+        config_from_params({"latency_budget_ms": -1})
+    with pytest.raises(RuntimeError):
+        config_from_params({"model_watch_interval": 0})
+
+
+def test_predict_hbm_serving_term():
+    base = predict_hbm(rows=0, features=0, leaves=1)
+    assert "serving_model" not in base["residents"]
+    p = predict_hbm(rows=0, features=0, leaves=1, serving_trees=16,
+                    serving_nodes=128, serving_cols=28, serving_bins=256,
+                    serving_buckets=(1, 64, 4096))
+    assert p["residents"]["serving_model"] > 0
+    assert p["transients"]["serving_batches"] > 0
+    eng = PredictEngine([], 1, buckets=(1, 8))
+    pred = eng.memory_prediction()
+    assert pred["residents"]["serving_model"] >= 0
+    assert eng.preflight()["verdict"] in ("ok", "over_capacity")
+
+
+def test_model_server_coalesces_and_matches(binary_model, test_rows):
+    """Requests enqueued before start() coalesce into one microbatch; the
+    outputs are bit-identical to the engine-backed Predictor path."""
+    srv = ModelServer(booster=binary_model,
+                      params={"verbose": -1, "latency_budget_ms": 20.0},
+                      prewarm=False, autostart=False)
+    futs = [srv.submit(test_rows[i:i + 7]) for i in range(0, 137, 7)]
+    raw_fut = srv.submit(test_rows[:5], raw_score=True)
+    srv.start()
+    got = np.concatenate([f.result(timeout=120) for f in futs])
+    want = binary_model.predictor().attach_engine().predict(test_rows)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(
+        raw_fut.result(timeout=120),
+        binary_model.predict(test_rows[:5], raw_score=True))
+    stats = srv.stop()
+    assert stats["requests"] == len(futs) + 1
+    assert stats["batches"] < stats["requests"]          # coalesced
+    bucket_stats = stats["buckets"]
+    assert bucket_stats and all("p50_ms" in b and "p99_ms" in b
+                                and "hist" in b for b in
+                                bucket_stats.values())
+
+
+def _publish(tmp_path, prefix, iters, X, y):
+    """Train with snapshot_freq so the commit point lands at ``iters``."""
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "output_model": prefix,
+              "snapshot_freq": 5, "snapshot_resume": True}
+    ds = lgb.Dataset(np.asarray(X, np.float64),
+                     label=np.asarray(y, np.float32),
+                     params={"verbose": -1})
+    return lgb.train(params, ds, num_boost_round=iters)
+
+
+def test_hot_swap_mid_stream(tmp_path):
+    """The acceptance pin: a trainer publishing through the checkpoint
+    commit point is picked up by a live server without restart or failed
+    requests; in-flight requests complete on the old model, later ones
+    use the new, and every response equals exactly ONE model's output
+    (no torn reads)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 6)
+    y = (X.sum(axis=1) > 0)
+    prefix = str(tmp_path / "model.txt")
+    bst_a = _publish(tmp_path, prefix, 5, X, y)
+    Xt = rng.randn(40, 6).astype(np.float32).astype(np.float64)
+
+    srv = ModelServer(params={"verbose": -1, "model_watch": prefix,
+                              "model_watch_interval": 0.02,
+                              "latency_budget_ms": 0.5}, prewarm=False)
+    try:
+        assert srv.loaded_iteration == 5
+        old = np.asarray(srv.predict(Xt))
+        np.testing.assert_array_equal(
+            old, bst_a.inner.predictor().attach_engine().predict(Xt))
+
+        futures, stop = [], threading.Event()
+
+        def stream():
+            while not stop.is_set():
+                futures.append(srv.submit(Xt))
+                time.sleep(0.002)
+
+        t = threading.Thread(target=stream)
+        t.start()
+        try:
+            bst_b = _publish(tmp_path, prefix, 10, X, y)
+            deadline = time.time() + 60
+            while srv.loaded_iteration != 10 and time.time() < deadline:
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            t.join()
+        assert srv.loaded_iteration == 10
+        new = np.asarray(srv.predict(Xt))
+        np.testing.assert_array_equal(
+            new, bst_b.inner.predictor().attach_engine().predict(Xt))
+        assert not np.array_equal(old, new)
+
+        saw_new = False
+        for f in futures:                  # completion follows dispatch order
+            out = np.asarray(f.result(timeout=120))   # no failed requests
+            if np.array_equal(out, new):
+                saw_new = True
+                continue
+            # exactly the old model's output, and never after the new one
+            np.testing.assert_array_equal(out, old)
+            assert not saw_new, "old-model response after a new-model one"
+        stats = srv.stop()
+        assert stats["swaps"] >= 1
+        assert any(e.get("event") == "model_swap"
+                   for e in obs_counters.events())
+    finally:
+        srv._running = False
+
+
+def test_hot_swap_from_group_snapshot_set(tmp_path, binary_model):
+    """A coordinated (shard + manifest) set commits the same way: the
+    manifest is the admission, rank 0's shard carries the model text."""
+    prefix = str(tmp_path / "gm.txt")
+    state = {"version": 1, "iteration": 3}
+    checkpoint_mod.write_group_snapshot(
+        prefix, 3, binary_model.save_model_to_string(), state,
+        rank=0, world=1, fingerprint=0,
+        gather=lambda obj: [obj])
+    srv = ModelServer(params={"verbose": -1, "model_watch": prefix},
+                      prewarm=False, autostart=False)
+    try:
+        assert srv.loaded_iteration == 3
+        Xt = np.zeros((3, 6))
+        want = binary_model.predictor().attach_engine().predict(Xt)
+        srv.start()
+        np.testing.assert_array_equal(srv.predict(Xt), want)
+    finally:
+        srv.stop()
+
+
+def test_torn_commit_is_invisible(tmp_path, binary_model):
+    """A truncated snapshot (no valid CRC footer) never becomes the
+    served model."""
+    prefix = str(tmp_path / "torn.txt")
+    path = checkpoint_mod.snapshot_path(prefix, 7)
+    with open(path, "wb") as f:
+        f.write(b"tree\nnum_leaves=2\ngarbage")       # torn: no footer
+    srv = ModelServer(booster=binary_model,
+                      params={"verbose": -1, "model_watch": prefix},
+                      prewarm=False, autostart=False)
+    assert not srv._poll_model_watch()
+    assert srv.loaded_iteration is None               # kept initial model
+
+
+def test_serving_obs_report_section(binary_model, test_rows, tmp_path):
+    """Serving telemetry round-trips into the rendered obs report:
+    dispatch identity, the jit-entries gauge, per-bucket latency."""
+    trace = str(tmp_path / "serving.jsonl")
+    obs_counters.reset()
+    obs_trace.start(trace)
+    try:
+        srv = ModelServer(booster=binary_model, params={"verbose": -1},
+                          prewarm=False, autostart=False)
+        futs = [srv.submit(test_rows[:9]) for _ in range(4)]
+        srv.start()
+        for f in futs:
+            f.result(timeout=120)
+        srv.stop()
+        eng = PredictEngine(binary_model.models, 1, buckets=(16,))
+        eng.raw_scores(test_rows[:9])                 # xla dispatch too
+    finally:
+        obs_trace.stop()
+    md = render(trace)
+    assert "## Serving / predict" in md
+    assert "predict_jit_entries" in md
+    assert "p50 ms" in md
+    # engine phase spans landed in the phase table
+    assert "predict_traverse" in md
+
+
+def test_serving_http_surface(binary_model, test_rows):
+    from http.server import ThreadingHTTPServer
+    from lightgbm_tpu.serving import _run_http
+    srv = ModelServer(booster=binary_model, params={"verbose": -1},
+                      prewarm=False)
+    httpd_box = {}
+    orig_init = ThreadingHTTPServer.__init__
+
+    def patched(self, addr, handler):
+        orig_init(self, ("127.0.0.1", 0), handler)
+        httpd_box["srv"] = self
+
+    ThreadingHTTPServer.__init__ = patched
+    try:
+        t = threading.Thread(
+            target=lambda: _run_http(srv, 0), daemon=True)
+        t.start()
+        deadline = time.time() + 30
+        while "srv" not in httpd_box and time.time() < deadline:
+            time.sleep(0.01)
+        port = httpd_box["srv"].server_address[1]
+        body = json.dumps({"data": test_rows[:4].tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())["predictions"]
+        want = binary_model.predictor().attach_engine().predict(
+            test_rows[:4])
+        np.testing.assert_array_equal(np.asarray(out), want)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=60) as r:
+            stats = json.loads(r.read())
+        assert stats["requests"] >= 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=60) as r:
+            assert json.loads(r.read())["ok"] is True
+    finally:
+        ThreadingHTTPServer.__init__ = orig_init
+        if "srv" in httpd_box:
+            httpd_box["srv"].shutdown()
+        srv.stop()
