@@ -206,28 +206,46 @@ def _leaves_sweep(params, n_rows, n_feat, sparsity):
     rows = min(n_rows, 200_000)
     lo, hi = 31, 255
     n_timed = int(os.environ.get("BENCH_LEAVES_SWEEP_TREES", 2))
-    sec = {}
     ds = None
-    for leaves in (lo, hi):
-        p = dict(params, num_leaves=leaves)
-        cfg = config_from_params(p)
-        if ds is None:      # num_leaves never keys dataset construction
-            ds = _construct_cached(
-                lambda: make_data(rows, n_feat, sparsity), cfg, rows,
-                n_feat, sparsity, p)
-        booster = create_boosting(cfg, ds, create_objective(cfg))
-        booster.train_one_iter()              # warmup (compile)
-        jax.block_until_ready(booster.scores)
-        t0 = time.perf_counter()
-        for _ in range(n_timed):
-            booster.train_one_iter()
-        jax.block_until_ready(booster.scores)
-        sec[leaves] = (time.perf_counter() - t0) / n_timed
-    marginal = (sec[hi] - sec[lo]) / (hi - lo) * 1e3
+
+    def measure(split_find):
+        nonlocal ds
+        sec = {}
+        for leaves in (lo, hi):
+            p = dict(params, num_leaves=leaves)
+            if split_find is not None:
+                p["split_find"] = split_find
+            cfg = config_from_params(p)
+            if ds is None:      # num_leaves never keys dataset construction
+                ds = _construct_cached(
+                    lambda: make_data(rows, n_feat, sparsity), cfg, rows,
+                    n_feat, sparsity, p)
+            booster = create_boosting(cfg, ds, create_objective(cfg))
+            booster.train_one_iter()              # warmup (compile)
+            jax.block_until_ready(booster.scores)
+            t0 = time.perf_counter()
+            for _ in range(n_timed):
+                booster.train_one_iter()
+            jax.block_until_ready(booster.scores)
+            sec[leaves] = (time.perf_counter() - t0) / n_timed
+        return sec, (sec[hi] - sec[lo]) / (hi - lo) * 1e3
+
+    sec, marginal = measure(None)         # the configured default
     obs_counters.gauge("leaves_sweep_marginal_ms_per_leaf", marginal)
-    return {"rows": rows, "leaves": [lo, hi],
-            "sec_per_tree": {str(k): round(v, 4) for k, v in sec.items()},
-            "marginal_ms_per_leaf": round(marginal, 3)}
+    out = {"rows": rows, "leaves": [lo, hi],
+           "split_find": params.get("split_find", "fused"),
+           "sec_per_tree": {str(k): round(v, 4) for k, v in sec.items()},
+           "marginal_ms_per_leaf": round(marginal, 3)}
+    # in-rung split-find A/B (round 8): the chain forced-baseline partner
+    # rides the same dataset/process so the pair shares host conditions;
+    # BENCH_LEAVES_AB=0 skips the extra two boosters
+    if os.environ.get("BENCH_LEAVES_AB", "") != "0" \
+            and params.get("split_find", "fused") != "chain":
+        sec_c, marginal_c = measure("chain")
+        out["chain_sec_per_tree"] = {str(k): round(v, 4)
+                                     for k, v in sec_c.items()}
+        out["chain_marginal_ms_per_leaf"] = round(marginal_c, 3)
+    return out
 
 
 def _serving_rung(booster, n_feat, sparsity):
@@ -250,12 +268,20 @@ def _serving_rung(booster, n_feat, sparsity):
     # the engine exactly as serving would build it ('auto' backend:
     # SoA microbatch executables on an accelerator, the OpenMP C++
     # traversal on a bare-CPU backend) plus a forced-xla twin so the
-    # jitted path is measured on every tier
+    # jitted path is measured on every tier, and — when the model packs —
+    # the packed-node-word traversal twin (serving_traversal=packed) so
+    # the xla-vs-packed headroom is a tracked number per round
     auto_eng = booster.predict_engine(prewarm=True)
     from lightgbm_tpu.inference import PredictEngine
-    xla_eng = auto_eng if auto_eng.backend == "xla" else \
+    xla_eng = auto_eng if (auto_eng.backend, auto_eng.traversal) == \
+        ("xla", "xla") else \
         PredictEngine(booster.models, booster.num_class,
-                      prewarm=True, backend="xla")
+                      prewarm=True, backend="xla", traversal="xla")
+    packed_eng = PredictEngine(booster.models, booster.num_class,
+                               prewarm=False, backend="xla",
+                               traversal="packed")
+    packed_eng = packed_eng.prewarm() if packed_eng.traversal == "packed" \
+        else None                      # unpackable model: no packed row
     entries_warm = jit_entries()
     p = booster.predictor()            # engine attached (just built)
 
@@ -267,10 +293,14 @@ def _serving_rung(booster, n_feat, sparsity):
     old_s = time.perf_counter() - t0
 
     out = {"predict_jit_entries": entries_warm,
-           "backend": auto_eng.backend, "backends": {}}
+           "backend": auto_eng.backend,
+           "traversal": auto_eng.traversal, "backends": {}}
     engines = {auto_eng.backend: auto_eng}
     if xla_eng is not auto_eng:
         engines["xla"] = xla_eng
+    if packed_eng is not None and \
+            (auto_eng.backend, auto_eng.traversal) != ("xla", "packed"):
+        engines["xla+packed"] = packed_eng
     for name, eng in engines.items():
         buckets = {}
         for b, reps in ((1, 50), (64, 30), (4096, 5)):
@@ -431,6 +461,10 @@ def child_main():
     # compares mislabeled numbers.  The kernel identity is snapshotted
     # BEFORE the leaves-sweep micro-rung trains its extra boosters.
     observed = obs_counters.observed_kernel()
+    # split-find identity of the MEASURED training, snapshotted before the
+    # leaves-sweep micro-rung trains its extra (possibly chain-forced A/B)
+    # boosters into the same counter registry
+    split_find_counts = obs_counters.get("split_find_dispatch")
 
     # device-memory evidence, also snapshotted BEFORE the leaves sweep so
     # its extra boosters never inflate the measured number: the predicted
@@ -491,6 +525,10 @@ def child_main():
     telemetry = {
         "observed_kernel": observed,
         "hist_dispatch": obs_counters.get("hist_dispatch"),
+        # split-find identity (round 8): which best-split scan the grower
+        # actually traced — decide_flips refuses a split_find A/B whose
+        # label disagrees with this
+        "split_find_dispatch": split_find_counts,
         "layout_downgrades": obs_counters.events("layout_downgrade"),
     }
     if trace_file:

@@ -260,7 +260,8 @@ class GBDT:
             max_cat_group=cfg.max_cat_group,
             cat_smooth_ratio=cfg.cat_smooth_ratio,
             min_cat_smooth=cfg.min_cat_smooth,
-            max_cat_smooth=cfg.max_cat_smooth)
+            max_cat_smooth=cfg.max_cat_smooth,
+            split_find=cfg.split_find)
         self._setup_grower(cfg, train)
         # rollback must act BEFORE the next iteration trains on poisoned
         # scores, so it forces synchronous tree materialization; the cheap
@@ -353,9 +354,8 @@ class GBDT:
             packed_cols=(plan.num_storage_cols if plan is not None else 0),
             valid_rows=sum(vs.data.num_data for vs in self.valid_sets),
             ordered_bins=self.grower_cfg.ordered_bins == "on",
-            gather_words=(self.grower_cfg.gather_words == "on"
-                          or (self.grower_cfg.gather_words == "auto"
-                              and _on_tpu())),
+            # 'auto' resolves ON everywhere since round 8 (grower.py)
+            gather_words=self.grower_cfg.gather_words in ("on", "auto"),
             bucket_min_log2=self.grower_cfg.bucket_min_log2)
         self.memory_prediction = pred
         obs_memory.preflight(
@@ -1184,7 +1184,8 @@ class GBDT:
         self._pred_engine_ntrees = -1
 
     def predict_engine(self, prewarm: bool = False, buckets=None,
-                       build: bool = True, backend: str = "auto"):
+                       build: bool = True, backend: str = "auto",
+                       traversal: str = None):
         """The cached SoA serving engine for the current model
         (lightgbm_tpu.inference.PredictEngine; docs/SERVING.md).  Built at
         most once per model state: the flatten + threshold tables are
@@ -1198,10 +1199,12 @@ class GBDT:
                 return None
             from .inference import PredictEngine
             kw = {} if buckets is None else {"buckets": buckets}
+            if traversal is None:
+                traversal = getattr(self.config, "serving_traversal", "auto")
             self._pred_engine = PredictEngine(
                 self.models, self.num_class, prewarm=prewarm,
                 backend=backend, model_str=self.save_model_to_string(),
-                **kw)
+                traversal=traversal, **kw)
             self._pred_engine_ntrees = len(self.models)
         elif prewarm and not self._pred_engine._warmed:
             self._pred_engine.prewarm()

@@ -307,6 +307,17 @@ class Config:
                                      # atomically between microbatches
                                      # ("" = no watching)
     model_watch_interval: float = 1.0  # seconds between model_watch polls
+    serving_traversal: str = "auto"  # serving-engine tree traversal:
+                                     # auto | xla | packed.  ``packed``
+                                     # folds each node's fields into one
+                                     # i32 word pair and walks a fixed
+                                     # max-depth fori ladder (one fused
+                                     # gather per step instead of eight) —
+                                     # bit-identical raw margins; ``auto``
+                                     # picks packed on XLA:CPU where the
+                                     # scalar gather lowering makes it
+                                     # ~1.6x, and the classic while-loop
+                                     # traversal elsewhere
 
     # distributed (reference NetworkConfig -> JAX mesh knobs)
     num_machines: int = 1
@@ -342,6 +353,14 @@ class Config:
                                    # word matrix so each split's read is
                                    # ONE row gather: auto | on | off
     pallas_hist_impl: str = "auto"  # kernel form: auto | onehot | nibble
+    split_find: str = "fused"      # best-split scan formulation: fused
+                                   # (gain scan fused onto the hot
+                                   # histogram — per-direction reductions,
+                                   # loop-invariant masks hoisted, no
+                                   # packed candidate arrays) | chain (the
+                                   # historical packed-argmax form, kept as
+                                   # the forced A/B baseline).  Trees are
+                                   # bit-identical either way (pinned)
     pallas_fused: str = "auto"     # gen-2 fused-gather nibble histogram
                                    # kernel (in-kernel row DMA, no gather
                                    # pass, no pow2 staging buffer):
@@ -519,6 +538,12 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.pallas_fused not in ("auto", "on", "off"):
         log.fatal("pallas_fused must be auto, on, or off; got %r",
                   cfg.pallas_fused)
+    if cfg.split_find not in ("fused", "chain"):
+        log.fatal("split_find must be fused or chain; got %r",
+                  cfg.split_find)
+    if cfg.serving_traversal not in ("auto", "xla", "packed"):
+        log.fatal("serving_traversal must be auto, xla, or packed; got %r",
+                  cfg.serving_traversal)
     if cfg.ordered_bins not in ("auto", "on", "off"):
         log.fatal("ordered_bins must be auto, on, or off; got %r",
                   cfg.ordered_bins)

@@ -45,10 +45,10 @@ from .data.packing import (PACK_JOINT_BINS, pack_fused_panel,
                            unpack_gather_words)
 from .obs import trace as obs_trace
 from .obs.counters import counters as obs_counters
-from .ops.histogram import on_tpu, subset_histogram, subset_histogram_fused
+from .ops.histogram import subset_histogram, subset_histogram_fused
 from .ops.pallas_hist import FUSED_MAX_COLS, NIB, fused_idx_fetch
 from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
-                        best_split, leaf_output)
+                        best_split, leaf_output, make_fused_ctx)
 from .utils import log
 
 
@@ -91,6 +91,14 @@ class GrowerConfig(NamedTuple):
     hist_interpret: bool = False     # run Pallas hist kernels in interpret
                                      # mode — CPU-side parity tests of the
                                      # fused/pallas paths (never on-chip)
+    split_find: str = "fused"        # best-split scan formulation: fused
+                                     # (per-direction reductions right off
+                                     # the hot histogram, loop-invariant
+                                     # masks hoisted out of the grow loop)
+                                     # | chain (the historical packed
+                                     # [F, 2B, 4] candidate form — the
+                                     # forced A/B baseline).  Bit-identical
+                                     # trees either way (pinned).
 
     def split_config(self) -> SplitConfig:
         return SplitConfig(self.lambda_l1, self.lambda_l2, self.min_gain_to_split,
@@ -98,7 +106,8 @@ class GrowerConfig(NamedTuple):
                            self.has_categorical, self.has_missing,
                            self.max_cat_threshold,
                            self.max_cat_group, self.cat_smooth_ratio,
-                           self.min_cat_smooth, self.max_cat_smooth)
+                           self.min_cat_smooth, self.max_cat_smooth,
+                           self.split_find)
 
 
 class TreeArrays(NamedTuple):
@@ -206,17 +215,38 @@ def _row_leaf_from_intervals(order, leaf_start, leaf_cnt, n):
 
 
 class _LoopState(NamedTuple):
+    """Grow-loop carry.  The per-leaf split pool and the tree-in-progress
+    travel as PACKED row matrices — one row write per updated leaf/node
+    instead of one scatter per field (round-8 frontier packing: at 255
+    leaves the ~30 per-split field scatters were a measurable slice of the
+    fixed cost, and every extra carried-array scatter is copy-insertion
+    surface).  ``TreeArrays`` is unpacked ONCE after the loop."""
     step: jnp.ndarray
     order: jnp.ndarray           # [N + maxbuf] i32: row ids grouped by leaf
     obins: jnp.ndarray           # [N + maxbuf, C] leaf-ordered bin matrix
     ow: jnp.ndarray              # [N + maxbuf, 3] leaf-ordered (g, h, c)
     #                              (both [0, 0] dummies unless ordered_bins)
-    leaf_start: jnp.ndarray      # [L] i32: first position of each leaf
-    leaf_cnt: jnp.ndarray        # [L] i32: local row count of each leaf
+    lsc: jnp.ndarray             # [L, 2] i32: (first position, local count)
     hist_store: jnp.ndarray      # [L, F, B, 3]: per-leaf histograms
     feat_ok: jnp.ndarray         # [L, E] bool: per-leaf is_splittable flags
-    splits: SplitResult          # per-leaf SoA, each field [L]
-    tree: TreeArrays
+    sgain: jnp.ndarray           # [L] f32: per-leaf best gain (the heap key)
+    sf32: jnp.ndarray            # [L, 8] f32 split pool: left_sum_g,
+    #                              left_sum_h, left_count, right_sum_g,
+    #                              right_sum_h, right_count, left_output,
+    #                              right_output
+    si32: jnp.ndarray            # [L, 3] i32 split pool: feature,
+    #                              threshold, default_left
+    scat: jnp.ndarray            # [L] bool: categorical split ([0] when the
+    #                              dataset has no categoricals)
+    scatb: jnp.ndarray           # [L, B] bool: bins routed left ([0, 0])
+    tnf: jnp.ndarray             # [L-1, 3] f32 nodes: split_gain,
+    #                              internal_value, internal_count
+    tni: jnp.ndarray             # [L-1, 5] i32 nodes: feature, threshold,
+    #                              default_left, left_child, right_child
+    tlf: jnp.ndarray             # [L, 2] f32 leaves: value, count
+    tli: jnp.ndarray             # [L, 2] i32 leaves: parent, depth
+    tcat: jnp.ndarray            # [L-1] bool: node is categorical ([0])
+    tcatb: jnp.ndarray           # [L-1, B] bool: node cat_bins ([0, 0])
 
 
 class SerialStrategy:
@@ -249,7 +279,14 @@ class SerialStrategy:
     def setup(self, bins, meta: FeatureMeta, feat_valid):
         maps = (make_expand_maps(meta, self.cfg.max_bin)
                 if meta.col is not None else None)
-        return (meta, feat_valid, maps)
+        scfg = self.cfg.split_config()
+        # the fused scan's keep/candidate masks depend only on the feature
+        # metadata — building them HERE hoists them out of the grow loop's
+        # body (the chain path re-derives them every split)
+        fctx = (make_fused_ctx(meta.num_bin, meta.missing_type,
+                               meta.default_bin, self.cfg.max_bin, scfg)
+                if scfg.split_find == "fused" else None)
+        return (meta, feat_valid, maps, fctx)
 
     def hist_bins(self, ctx, bins):
         return bins
@@ -258,13 +295,14 @@ class SerialStrategy:
         return hist
 
     def find(self, ctx, hist, pg, ph, pc, feat_ok):
-        meta, feat_valid, maps = ctx
+        meta, feat_valid, maps, fctx = ctx
         if maps is not None:
             hist = expand_bundle_hist(hist, pg, ph, pc, maps)
         return best_split(hist, pg, ph, pc, meta.num_bin,
                           meta.missing_type, meta.default_bin,
                           feat_valid & feat_ok, self.cfg.split_config(),
-                          is_cat=meta.is_categorical, with_feat_ok=True)
+                          is_cat=meta.is_categorical, with_feat_ok=True,
+                          fused_ctx=fctx)
 
     def reduce_scalar(self, x):
         return x
@@ -330,18 +368,6 @@ def expand_bundle_hist(hist, pg, ph, pc, maps):
 
 def _set(arr, idx, value):
     return arr.at[idx].set(value)
-
-
-def _update_splits(splits: SplitResult, idx, res: SplitResult,
-                   skip=()) -> SplitResult:
-    """Write ``res`` into the per-leaf SoA at ``idx``; fields in ``skip``
-    keep their stored arrays untouched (the grower skips the categorical
-    fields when the dataset has none — the incoming values are all-zero
-    and the stored arrays already are, so the scatters would be per-split
-    no-op work)."""
-    return SplitResult(*[a if name in skip else _set(a, idx, v)
-                         for name, a, v in zip(SplitResult._fields,
-                                               splits, res)])
 
 
 def _depth_gate(res: SplitResult, leaf_depth, max_depth) -> SplitResult:
@@ -453,7 +479,13 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
 
         use_words = cfg.gather_words
         if use_words == "auto":
-            use_words = "on" if on_tpu() else "off"
+            # round 8: 'auto' now resolves ON for the CPU rungs too — the
+            # per-element gather cost argument holds there as well, and
+            # with the panel fold (one u32 row gather per split instead of
+            # a u8 row gather + 3 weight gathers) the 200k x 28 CPU
+            # leaves-sweep marginal measured ~9% lower.  Explicit
+            # gather_words=off remains the escape hatch.
+            use_words = "on"
         if hbins.dtype.itemsize > 2:
             if cfg.gather_words == "on":
                 log.warning("gather_words=on ignored: bin dtype %s is wider "
@@ -576,7 +608,12 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
         tracer = obs_trace.get_tracer()
 
         def find(hist, pg, ph, pc, feat_ok):
-            with tracer.span("split_find", traced=True), \
+            # trace-time identity evidence (the hist_dispatch discipline):
+            # bench rungs / decide_flips verify the split_find label
+            # against this counter
+            obs_counters.inc("split_find_dispatch", impl=cfg.split_find)
+            with tracer.span("split_find", traced=True,
+                             impl=cfg.split_find), \
                     jax.named_scope("split_find"):
                 return strategy.find(ctx, hist, pg, ph, pc, feat_ok)
 
@@ -664,8 +701,11 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
         def partition_branch(size):
 
             def branch(args):
-                (order, obins, ow, start, cnt,
-                 feat, thr, dleft, is_cat_l, cat_row) = args
+                if cfg.has_categorical:
+                    (order, obins, ow, start, cnt,
+                     feat, thr, dleft, is_cat_l, cat_row) = args
+                else:       # no categorical routing ops traced at all
+                    order, obins, ow, start, cnt, feat, thr, dleft = args
                 win = lax.dynamic_slice(order, (start,), (size,))
                 j = jnp.arange(size, dtype=jnp.int32)
                 valid = j < cnt
@@ -691,8 +731,9 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                 is_missing = (((mt_f == MISSING_NAN) & (binf == nb_f - 1))
                               | ((mt_f == MISSING_ZERO) & (binf == db_f)))
                 goes_left = jnp.where(is_missing, dleft, binf <= thr)
-                cat_go_left = cat_row[jnp.clip(binf, 0, cfg.max_bin - 1)]
-                goes_left = jnp.where(is_cat_l, cat_go_left, goes_left)
+                if cfg.has_categorical:
+                    cat_go_left = cat_row[jnp.clip(binf, 0, cfg.max_bin - 1)]
+                    goes_left = jnp.where(is_cat_l, cat_go_left, goes_left)
                 goes_left = goes_left & valid
                 use_sort = cfg.partition_impl == "sort"
                 # the Pallas compaction kernel needs 512-row blocks, f32-
@@ -847,9 +888,6 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
         else:
             obins0 = jnp.zeros((0, 0), hbins.dtype)
             ow0 = jnp.zeros((0, 0), dtype)
-        leaf_start0 = jnp.zeros((L,), jnp.int32)
-        leaf_cnt0 = _set(jnp.zeros((L,), jnp.int32), 0, n)
-
         num_logical = meta.num_bin.shape[0]
         feat_ok_all = jnp.ones((num_logical,), bool)
         with tracer.span("histogram", site="root", traced=True), \
@@ -877,114 +915,126 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
         feat_ok_store0 = jnp.zeros((L, num_logical), bool).at[0].set(
             root_feat_ok)
 
-        def blank_res(x):
-            return jnp.zeros((L,) + x.shape, x.dtype)
+        def pool_rows(res: SplitResult, axis: int):
+            """SplitResult fields -> packed pool rows (f32, i32)."""
+            f32 = jnp.stack([res.left_sum_g, res.left_sum_h, res.left_count,
+                             res.right_sum_g, res.right_sum_h,
+                             res.right_count, res.left_output,
+                             res.right_output], axis=axis)
+            i32 = jnp.stack([res.feature, res.threshold,
+                             res.default_left.astype(jnp.int32)], axis=axis)
+            return f32, i32
 
-        splits = SplitResult(*[blank_res(v) for v in res_root])
-        splits = splits._replace(gain=jnp.full((L,), -jnp.inf, res_root.gain.dtype))
-        splits = _update_splits(splits, 0, res_root)
+        root_f32, root_i32 = pool_rows(res_root, 0)
+        sgain0 = jnp.full((L,), -jnp.inf, res_root.gain.dtype).at[0].set(
+            res_root.gain)
+        sf32_0 = jnp.zeros((L, 8), dtype).at[0].set(root_f32)
+        si32_0 = jnp.zeros((L, 3), jnp.int32).at[0].set(root_i32)
+        if cfg.has_categorical:
+            scat0 = jnp.zeros((L,), bool).at[0].set(res_root.is_cat)
+            scatb0 = jnp.zeros((L, cfg.max_bin), bool).at[0].set(
+                res_root.cat_bins)
+            tcat0 = jnp.zeros((L - 1,), bool)
+            tcatb0 = jnp.zeros((L - 1, cfg.max_bin), bool)
+        else:   # statically absent: no categorical state is carried at all
+            scat0 = jnp.zeros((0,), bool)
+            scatb0 = jnp.zeros((0, 0), bool)
+            tcat0 = jnp.zeros((0,), bool)
+            tcatb0 = jnp.zeros((0, 0), bool)
 
-        tree = TreeArrays(
-            num_leaves=jnp.asarray(1, jnp.int32),
-            split_feature=jnp.zeros((L - 1,), jnp.int32),
-            threshold_bin=jnp.zeros((L - 1,), jnp.int32),
-            default_left=jnp.zeros((L - 1,), bool),
-            left_child=jnp.zeros((L - 1,), jnp.int32),
-            right_child=jnp.zeros((L - 1,), jnp.int32),
-            split_gain=jnp.zeros((L - 1,), dtype),
-            internal_value=jnp.zeros((L - 1,), dtype),
-            internal_count=jnp.zeros((L - 1,), dtype),
-            leaf_value=jnp.zeros((L,), dtype),
-            leaf_count=_set(jnp.zeros((L,), dtype), 0, root_c),
-            leaf_parent=jnp.full((L,), -1, jnp.int32),
-            leaf_depth=jnp.zeros((L,), jnp.int32),
-            is_cat=jnp.zeros((L - 1,), bool),
-            cat_bins=jnp.zeros((L - 1, cfg.max_bin), bool),
-        )
+        lsc0 = jnp.zeros((L, 2), jnp.int32).at[0, 1].set(n)
+        tnf0 = jnp.zeros((L - 1, 3), dtype)
+        tni0 = jnp.zeros((L - 1, 5), jnp.int32)
+        tlf0 = jnp.zeros((L, 2), dtype).at[0, 1].set(root_c)
+        tli0 = jnp.concatenate([jnp.full((L, 1), -1, jnp.int32),
+                                jnp.zeros((L, 1), jnp.int32)], axis=1)
 
         def cond(state: _LoopState):
             ok = ((state.step < L - 1)
-                  & (jnp.max(state.splits.gain) > 0.0))
+                  & (jnp.max(state.sgain) > 0.0))
             if max_steps is not None:
                 ok = ok & (state.step < max_steps)
             return ok
 
         def body(state: _LoopState) -> _LoopState:
             i = state.step
-            splits = state.splits
-            tree = state.tree
-            l = jnp.argmax(splits.gain).astype(jnp.int32)
+            l = jnp.argmax(state.sgain).astype(jnp.int32)
             new_leaf = i + 1
             node = i
+            pair_lr = jnp.stack([l, new_leaf])
 
-            feat = splits.feature[l]
-            thr = splits.threshold[l]
-            dleft = splits.default_left[l]
+            # one row read per pool instead of one gather per field
+            irow = lax.dynamic_index_in_dim(state.si32, l, axis=0,
+                                            keepdims=False)
+            frow = lax.dynamic_index_in_dim(state.sf32, l, axis=0,
+                                            keepdims=False)
+            feat, thr = irow[0], irow[1]
+            dleft = irow[2].astype(bool)
 
             # --- localized routing + stable partition of leaf l's window
             #     (only that leaf's slice of ``order`` is touched) ---------
-            start = state.leaf_start[l]
-            cnt = state.leaf_cnt[l]
+            lrow = lax.dynamic_index_in_dim(state.lsc, l, axis=0,
+                                            keepdims=False)
+            start, cnt = lrow[0], lrow[1]
             kp = _bucket_index(cnt, bsizes)
+            cat_args = ((state.scat[l], state.scatb[l])
+                        if cfg.has_categorical else ())
             with tracer.span("partition", traced=True), \
                     jax.named_scope("partition"):
                 order, obins, ow, nl = lax.switch(
                     kp, pbranches,
                     (state.order, state.obins, state.ow, start, cnt,
-                     feat, thr, dleft, splits.is_cat[l], splits.cat_bins[l]))
+                     feat, thr, dleft) + cat_args)
             nr = cnt - nl
-            leaf_start = _set(state.leaf_start, new_leaf, start + nl)
-            leaf_cnt = _set(_set(state.leaf_cnt, l, nl), new_leaf, nr)
+            lsc = state.lsc.at[pair_lr].set(
+                jnp.stack([jnp.stack([start, nl]),
+                           jnp.stack([start + nl, nr])]),
+                unique_indices=True, mode="promise_in_bounds")
 
-            # --- record the node (Tree::Split, tree.h:319-345) ---------------
-            parent_node = tree.leaf_parent[l]
-            pn = jnp.maximum(parent_node, 0)
-            node_iota = jnp.arange(L - 1, dtype=jnp.int32)
-            relink = (parent_node >= 0) & (node_iota == pn)
-            left_child = jnp.where(relink & (tree.left_child == ~l),
-                                   node, tree.left_child)
-            right_child = jnp.where(relink & (tree.right_child == ~l),
-                                    node, tree.right_child)
-            left_child = _set(left_child, node, ~l)
-            right_child = _set(right_child, node, ~new_leaf)
+            # --- record the node (Tree::Split, tree.h:319-345): one row
+            #     write per packed table + one element write that relinks
+            #     the parent's child pointer (the root split has no parent;
+            #     its relink is redirected into row ``node``, which the
+            #     full row write below overwrites) --------------------------
+            prow = lax.dynamic_index_in_dim(state.tli, l, axis=0,
+                                            keepdims=False)
+            parent_node = prow[0]
+            child_depth = prow[1] + 1
+            pn_safe = jnp.where(parent_node >= 0, parent_node, node)
+            side = jnp.where(state.tni[pn_safe, 3] == ~l, 3, 4)
+            tni = state.tni.at[pn_safe, side].set(
+                node, mode="promise_in_bounds")
+            tni = tni.at[node].set(
+                jnp.stack([feat, thr, irow[2], ~l, ~new_leaf]),
+                mode="promise_in_bounds")
 
-            parent_g = splits.left_sum_g[l] + splits.right_sum_g[l]
-            parent_h = splits.left_sum_h[l] + splits.right_sum_h[l]
-            parent_depth = tree.leaf_depth[l]
-            child_depth = parent_depth + 1
-            # without categorical features every categorical field is
-            # statically all-zero — skip their per-split scatters
-            # (cat_bins is the [L, B] one, real per-step work)
-            cat_upd = dict(
-                is_cat=_set(tree.is_cat, node, splits.is_cat[l]),
-                cat_bins=tree.cat_bins.at[node].set(splits.cat_bins[l]),
-            ) if cfg.has_categorical else {}
-            tree = tree._replace(
-                num_leaves=new_leaf + 1,
-                split_feature=_set(tree.split_feature, node, feat),
-                threshold_bin=_set(tree.threshold_bin, node, thr),
-                default_left=_set(tree.default_left, node, dleft),
-                left_child=left_child,
-                right_child=right_child,
-                split_gain=_set(tree.split_gain, node, splits.gain[l]),
-                internal_value=_set(tree.internal_value, node,
-                                    leaf_output(parent_g, parent_h,
-                                                cfg.lambda_l1, cfg.lambda_l2)),
-                internal_count=_set(tree.internal_count, node, tree.leaf_count[l]),
-                leaf_value=_set(_set(tree.leaf_value, l, splits.left_output[l]),
-                                new_leaf, splits.right_output[l]),
-                leaf_count=_set(_set(tree.leaf_count, l, splits.left_count[l]),
-                                new_leaf, splits.right_count[l]),
-                leaf_parent=_set(_set(tree.leaf_parent, l, node), new_leaf, node),
-                leaf_depth=_set(_set(tree.leaf_depth, l, child_depth),
-                                new_leaf, child_depth),
-                **cat_upd,
-            )
+            parent_g = frow[0] + frow[3]
+            parent_h = frow[1] + frow[4]
+            tnf = state.tnf.at[node].set(
+                jnp.stack([state.sgain[l],
+                           leaf_output(parent_g, parent_h,
+                                       cfg.lambda_l1, cfg.lambda_l2),
+                           state.tlf[l, 1]]),
+                mode="promise_in_bounds")
+            tlf = state.tlf.at[pair_lr].set(
+                jnp.stack([jnp.stack([frow[6], frow[2]]),
+                           jnp.stack([frow[7], frow[5]])]),
+                unique_indices=True, mode="promise_in_bounds")
+            tli = state.tli.at[pair_lr].set(
+                jnp.broadcast_to(jnp.stack([node, child_depth]), (2, 2)),
+                unique_indices=True, mode="promise_in_bounds")
+            if cfg.has_categorical:
+                tcat = state.tcat.at[node].set(cat_args[0],
+                                               mode="promise_in_bounds")
+                tcatb = state.tcatb.at[node].set(cat_args[1],
+                                                 mode="promise_in_bounds")
+            else:
+                tcat, tcatb = state.tcat, state.tcatb
 
             # --- smaller-child histogram + parent subtraction ----------------
             # (the reference's smaller/larger trick,
             #  serial_tree_learner.cpp:326-404,482-488)
-            small_left = splits.left_count[l] <= splits.right_count[l]
+            small_left = frow[2] <= frow[5]
             sstart = jnp.where(small_left, start, start + nl)
             scnt = jnp.where(small_left, nl, nr)   # LOCAL count of that child
             with tracer.span("histogram", site="split", traced=True), \
@@ -1001,51 +1051,84 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
             hist_parent = lax.dynamic_index_in_dim(state.hist_store, l, axis=0,
                                                    keepdims=False)
             hist_large = hist_parent - hist_small
-            hist_l = jnp.where(small_left, hist_small, hist_large)
-            hist_r = jnp.where(small_left, hist_large, hist_small)
-            # both children land in the store through ONE fused scatter.
-            # The previous two-dynamic_update_slice chain read the carried
-            # store (the parent fetch above) and then updated it twice,
-            # and XLA:CPU's copy insertion resolved that interference by
-            # cloning the WHOLE [L, F, B, 3] pool twice per split — at
-            # 255 leaves x 28 x 256 that was ~44 MB of memcpy per split,
-            # the dominant per-split fixed cost of deep trees (measured
-            # ~5 ms/split; docs/PERF.md round-7 cost model).  The single
-            # pair scatter updates the pool in place.
-            pair = jnp.stack([l, new_leaf])
-            hist_store = state.hist_store.at[pair].set(
-                jnp.stack([hist_l, hist_r]), unique_indices=True,
-                mode="promise_in_bounds")
+            # everything downstream runs in (smaller, larger) order and is
+            # written back through the PERMUTED pair index — the former
+            # [F, B, 3]-wide hist_l/hist_r selects become two scalar-level
+            # index selects (same slots, same values, fewer wide ops).
+            # Both children still land in the store through ONE fused pair
+            # scatter: the round-7 discovery stands — a read-then-double-
+            # dynamic_update_slice chain on the carried pool made XLA:CPU
+            # clone all 22 MB of it twice per split (docs/PERF.md round 7;
+            # pinned by tests/test_grow_jaxpr.py).
+            hist2 = jnp.stack([hist_small, hist_large])
+            pair_sl = jnp.where(small_left, pair_lr, pair_lr[::-1])
+            hist_store = state.hist_store.at[pair_sl].set(
+                hist2, unique_indices=True, mode="promise_in_bounds")
 
             # children scan only the features the PARENT found splittable
             # (serial_tree_learner.cpp:406-417 pruning heuristic).  Both
             # children go through ONE vmapped find: the candidate scan is
-            # dozens of small ops on [E, 2B] arrays whose cost on TPU is
+            # dozens of small ops on [E, B] arrays whose cost on TPU is
             # per-op launch, not math — batching the pair halves it
             fok_parent = lax.dynamic_index_in_dim(state.feat_ok, l, axis=0,
                                                   keepdims=False)
-            hist2 = jnp.stack([hist_l, hist_r])
-            pg2 = jnp.stack([splits.left_sum_g[l], splits.right_sum_g[l]])
-            ph2 = jnp.stack([splits.left_sum_h[l], splits.right_sum_h[l]])
-            pc2 = jnp.stack([splits.left_count[l], splits.right_count[l]])
+            lr3 = jnp.stack([lax.slice(frow, (0,), (3,)),
+                             lax.slice(frow, (3,), (6,))])   # [2, 3]
+            sl3 = jnp.where(small_left, lr3, lr3[::-1])
             res2, fok2 = jax.vmap(find, in_axes=(0, 0, 0, 0, None))(
-                hist2, pg2, ph2, pc2, fok_parent)
+                hist2, sl3[:, 0], sl3[:, 1], sl3[:, 2], fok_parent)
             res2 = _depth_gate(res2, child_depth, cfg.max_depth)
-            feat_ok = state.feat_ok.at[pair].set(fok2 & fok_parent[None, :],
-                                                 unique_indices=True)
-            splits = _update_splits(
-                splits, pair, res2,
-                skip=() if cfg.has_categorical else ("is_cat", "cat_bins"))
-            return _LoopState(i + 1, order, obins, ow, leaf_start,
-                              leaf_cnt, hist_store, feat_ok, splits, tree)
+            feat_ok = state.feat_ok.at[pair_sl].set(fok2 & fok_parent[None, :],
+                                                    unique_indices=True)
+            rows_f32, rows_i32 = pool_rows(res2, 1)
+            sgain = state.sgain.at[pair_sl].set(
+                res2.gain, unique_indices=True, mode="promise_in_bounds")
+            sf32 = state.sf32.at[pair_sl].set(
+                rows_f32, unique_indices=True, mode="promise_in_bounds")
+            si32 = state.si32.at[pair_sl].set(
+                rows_i32, unique_indices=True, mode="promise_in_bounds")
+            if cfg.has_categorical:
+                scat = state.scat.at[pair_sl].set(
+                    res2.is_cat, unique_indices=True,
+                    mode="promise_in_bounds")
+                scatb = state.scatb.at[pair_sl].set(
+                    res2.cat_bins, unique_indices=True,
+                    mode="promise_in_bounds")
+            else:
+                scat, scatb = state.scat, state.scatb
+            return _LoopState(i + 1, order, obins, ow, lsc, hist_store,
+                              feat_ok, sgain, sf32, si32, scat, scatb,
+                              tnf, tni, tlf, tli, tcat, tcatb)
 
         state = _LoopState(jnp.asarray(0, jnp.int32), order0, obins0, ow0,
-                           leaf_start0, leaf_cnt0, hist_store0,
-                           feat_ok_store0, splits, tree)
+                           lsc0, hist_store0, feat_ok_store0,
+                           sgain0, sf32_0, si32_0, scat0, scatb0,
+                           tnf0, tni0, tlf0, tli0, tcat0, tcatb0)
         state = lax.while_loop(cond, body, state)
-        row_leaf = _row_leaf_from_intervals(state.order, state.leaf_start,
-                                            state.leaf_cnt, n)
-        return state.tree, row_leaf
+        # unpack the packed carriers into the public TreeArrays ONCE per
+        # tree (a handful of column slices outside the loop)
+        tree = TreeArrays(
+            num_leaves=state.step + 1,
+            split_feature=state.tni[:, 0],
+            threshold_bin=state.tni[:, 1],
+            default_left=state.tni[:, 2].astype(bool),
+            left_child=state.tni[:, 3],
+            right_child=state.tni[:, 4],
+            split_gain=state.tnf[:, 0],
+            internal_value=state.tnf[:, 1],
+            internal_count=state.tnf[:, 2],
+            leaf_value=state.tlf[:, 0],
+            leaf_count=state.tlf[:, 1],
+            leaf_parent=state.tli[:, 0],
+            leaf_depth=state.tli[:, 1],
+            is_cat=(state.tcat if cfg.has_categorical
+                    else jnp.zeros((L - 1,), bool)),
+            cat_bins=(state.tcatb if cfg.has_categorical
+                      else jnp.zeros((L - 1, cfg.max_bin), bool)),
+        )
+        row_leaf = _row_leaf_from_intervals(state.order, state.lsc[:, 0],
+                                            state.lsc[:, 1], n)
+        return tree, row_leaf
 
     if step_limit:
         # profiler entry: traced step cap first, unpacked layout only

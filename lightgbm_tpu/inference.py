@@ -118,6 +118,17 @@ class SoABundle:
     is_cat: jnp.ndarray                # [Tp, P] bool
     cat_ref: jnp.ndarray               # [Tp, P] i32 row of cat_mask
     cat_mask: jnp.ndarray              # [C, W] bool over raw category values
+    # packed-node-word traversal (serving_traversal=packed): each node's
+    # routing fields folded into TWO i32 words so a traversal step costs
+    # one fused node gather + one data gather instead of eight scalar-
+    # lowered gathers (the measured ~1.6x XLA:CPU headroom of PR 8).
+    # None when the ensemble is not packable (categorical nodes, or field
+    # widths past the word budget) — the classic traversal always exists.
+    node_w0: Optional[jnp.ndarray] = None  # [Tp, P] i32: feat | thr<<12
+    #                                        | default_left<<28 | miss<<29
+    node_w1: Optional[jnp.ndarray] = None  # [Tp, P] i32: left | right<<16
+    #                                        (int16 two's complement halves)
+    max_depth: int = 0                 # fori ladder length (packed path)
 
     @property
     def num_cols(self) -> int:
@@ -199,6 +210,23 @@ class SoABundle:
                     u = thr64[fcomp[i]]
                     thr[ti, i] = int(np.searchsorted(
                         u, float(t.threshold[i])))
+        # packed-node-word twin: build whenever the ensemble fits the word
+        # budget (numerical-only, <=4096 used columns, <=65535 threshold
+        # ranks, <=32767 nodes/leaves).  Routing fields are folded into two
+        # i32 words; children are int16 two's complement halves of w1, so
+        # ``(w1 << 16) >> 16`` / ``w1 >> 16`` sign-extend them back exactly.
+        w0 = w1 = None
+        depth = 0
+        packable = (not ic.any() and fc <= 4096 and int(thr.max(initial=0))
+                    <= 0xffff and p <= 32767 and nb < (1 << 24))
+        if packable:
+            w0 = (feat.astype(np.int64) | (thr.astype(np.int64) << 12)
+                  | (dl.astype(np.int64) << 28)
+                  | (miss.astype(np.int64) << 29)).astype(np.int32)
+            w1 = ((lc.astype(np.int64) & 0xffff)
+                  | ((rc.astype(np.int64) & 0xffff) << 16)).astype(np.int32)
+            depth = max((t.max_depth() for t in trees if t.num_leaves > 1),
+                        default=0)
         return SoABundle(
             num_trees=num_trees, num_class=max(num_class, 1), tp=tp, p=p,
             cols=cols, thr64=thr64, leaf_value=lv,
@@ -206,7 +234,10 @@ class SoABundle:
             thr=jnp.asarray(thr), default_left=jnp.asarray(dl),
             miss=jnp.asarray(miss), left=jnp.asarray(lc),
             right=jnp.asarray(rc), is_cat=jnp.asarray(ic),
-            cat_ref=jnp.asarray(cref), cat_mask=jnp.asarray(cmask))
+            cat_ref=jnp.asarray(cref), cat_mask=jnp.asarray(cmask),
+            node_w0=jnp.asarray(w0) if w0 is not None else None,
+            node_w1=jnp.asarray(w1) if w1 is not None else None,
+            max_depth=int(depth))
 
     def device_args(self) -> tuple:
         return (self.feat, self.thr, self.default_left, self.miss,
@@ -301,6 +332,75 @@ def _leaves_from_binned_impl(bins, cats, nanm, zerom, *node_args):
     return _traverse(bins, cats, nanm, zerom, *node_args)
 
 
+# ------------------------------------------- packed-node-word traversal
+#
+# serving_traversal=packed: the whole per-node routing record rides in two
+# i32 words and the per-row feature payload in one (bin rank | nan bit |
+# zero bit), so each traversal step is ONE node-word gather pair + ONE
+# data-word gather — XLA:CPU lowers each separate gather scalar-by-scalar,
+# which made the classic 8-gather step the serving bottleneck (PR 8's
+# measured ~1.6x offline headroom).  The depth ladder is a ``fori_loop``
+# (no per-step ``any(node >= 0)`` reduction): every row reaches its leaf
+# within the bundle's max_depth, finished rows self-loop via the
+# ``active`` select.  Routing decisions are integer-for-integer identical
+# to ``_traverse``, so leaf indices — and therefore raw margins — are
+# bit-identical (pinned in tests/test_serving.py).
+
+
+def _traverse_packed(dat, w0s, w1s, depth):
+    n = dat.shape[0]
+    num_nodes = w0s.shape[1]
+
+    def one_tree(w0_t, w1_t):
+        def step(_, state):
+            node, leaf = state
+            nd = jnp.clip(node, 0, num_nodes - 1)
+            w0 = w0_t[nd]
+            w1 = w1_t[nd]
+            f = w0 & 0xfff
+            thr = (w0 >> 12) & 0xffff
+            dl = (w0 >> 28) & 1
+            mt = (w0 >> 29) & 3
+            dw = jnp.take_along_axis(dat, f[:, None], axis=1)[:, 0]
+            b = dw & 0xffffff
+            missing = (((mt == MISSING_NAN) & ((dw >> 24) & 1 == 1))
+                       | ((mt == MISSING_ZERO) & ((dw >> 25) & 1 == 1)))
+            go = jnp.where(missing, dl == 1, b <= thr)
+            nxt = jnp.where(go, (w1 << 16) >> 16, w1 >> 16)
+            active = node >= 0
+            return (jnp.where(active, nxt, node),
+                    jnp.where(active & (nxt < 0), ~nxt, leaf))
+
+        return lax.fori_loop(0, depth, step,
+                             (jnp.zeros((n,), jnp.int32),
+                              jnp.zeros((n,), jnp.int32)))[1]
+
+    return jax.vmap(one_tree)(w0s, w1s)
+
+
+def _pack_data_words(bins, nanm, zerom):
+    return (bins.astype(jnp.int32)
+            | (nanm.astype(jnp.int32) << 24)
+            | (zerom.astype(jnp.int32) << 25))
+
+
+def _leaves_from_raw_packed_impl(x, thr_table, w0s, w1s, depth):
+    nanm = jnp.isnan(x)
+    xz = jnp.where(nanm, jnp.float32(0), x)
+    zerom = jnp.abs(xz) <= jnp.float32(_ZERO_RANGE_F32)
+    bins = jax.vmap(lambda t, v: jnp.searchsorted(t, v, side="left"),
+                    in_axes=(0, 1), out_axes=1)(thr_table, xz)
+    return _traverse_packed(_pack_data_words(bins, nanm, zerom),
+                            w0s, w1s, depth)
+
+
+def _leaves_from_binned_packed_impl(bins, cats, nanm, zerom, w0s, w1s,
+                                    depth):
+    del cats     # packed bundles are numerical-only by construction
+    return _traverse_packed(_pack_data_words(bins, nanm, zerom),
+                            w0s, w1s, depth)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted(donate: bool):
     if donate:
@@ -309,6 +409,19 @@ def _jitted(donate: bool):
                         donate_argnums=(0, 1, 2, 3)))
     return (jax.jit(_leaves_from_raw_impl),
             jax.jit(_leaves_from_binned_impl))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_packed(donate: bool):
+    """Packed-node-word twins (serving_traversal=packed).  ``depth`` is a
+    traced scalar, so one executable pair serves every same-shape model —
+    the hot-swap zero-recompile contract is unchanged."""
+    if donate:
+        return (jax.jit(_leaves_from_raw_packed_impl, donate_argnums=(0,)),
+                jax.jit(_leaves_from_binned_packed_impl,
+                        donate_argnums=(0, 1, 2, 3)))
+    return (jax.jit(_leaves_from_raw_packed_impl),
+            jax.jit(_leaves_from_binned_packed_impl))
 
 
 def _donate_ok() -> bool:
@@ -327,7 +440,7 @@ def jit_entries() -> int:
     (Wrapping via ``_jitted`` is free — only executions compile.)"""
     total = 0
     for donate in (False, True):
-        for fn in _jitted(donate):
+        for fn in _jitted(donate) + _jitted_packed(donate):
             try:
                 total += int(fn._cache_size())
             except Exception:       # pragma: no cover - jax API drift
@@ -360,7 +473,8 @@ class PredictEngine:
     def __init__(self, trees: Sequence[Tree], num_class: int = 1,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  prewarm: bool = False, backend: str = "auto",
-                 model_str: Optional[str] = None):
+                 model_str: Optional[str] = None,
+                 traversal: str = "auto"):
         self.bundle = SoABundle.build(list(trees), num_class)
         self.buckets = parse_serving_buckets(buckets)
         self.num_class = max(num_class, 1)
@@ -372,8 +486,40 @@ class PredictEngine:
                              f"native; got {backend!r}")
         self._native = None
         self.backend = self._resolve_backend(backend, model_str)
+        if traversal not in ("auto", "xla", "packed"):
+            raise ValueError(f"predict engine traversal must be auto, xla, "
+                             f"or packed; got {traversal!r}")
+        self.traversal = self._resolve_traversal(traversal)
         if prewarm:
             self.prewarm()
+
+    def _resolve_traversal(self, want: str) -> str:
+        """serving_traversal ladder: ``packed`` walks two folded node
+        words down a fixed max-depth fori ladder — the XLA:CPU headroom
+        variant (the classic 8-gather step lowers scalar-by-scalar
+        there).  ``auto`` picks it on a bare-CPU backend whenever the
+        bundle packed; an explicit ``packed`` request on an unpackable
+        ensemble degrades LOUDLY to xla (never silently mislabeled)."""
+        packable = self.bundle.node_w0 is not None
+        if want == "xla":
+            return "xla"
+        if want == "packed":
+            if not packable:
+                log.warning("serving_traversal=packed unavailable "
+                            "(categorical nodes or field widths past the "
+                            "node-word budget); using the xla traversal")
+                obs_counters.event(
+                    "layout_downgrade", stage="serving",
+                    requested="serving_traversal=packed", resolved="xla",
+                    reason="bundle not packable (categorical nodes or "
+                           "field width)")
+                return "xla"
+            return "packed"
+        try:
+            backend_cpu = jax.default_backend() == "cpu"
+        except Exception:       # pragma: no cover - backend init failure
+            backend_cpu = True
+        return "packed" if (packable and backend_cpu) else "xla"
 
     def _resolve_backend(self, want: str, model_str: Optional[str]) -> str:
         if want == "xla":
@@ -432,14 +578,37 @@ class PredictEngine:
         a compile; a hot-swapped same-shape model reuses these
         executables."""
         self.preflight(hbm_budget)
-        raw_fn, _ = _jitted(self._donate)
-        args = self.bundle.device_args()
         for b in self.buckets:
             x = jnp.zeros((b, max(self.bundle.num_cols, 1)), jnp.float32)
-            jax.block_until_ready(raw_fn(x, self.bundle.thr_table, *args))
+            jax.block_until_ready(self._raw_fn()(x, *self._raw_args()))
         obs_counters.gauge("predict_jit_entries", jit_entries())
         self._warmed = True
         return self
+
+    # ------------------------------------------------- traversal plumbing
+
+    def _raw_fn(self):
+        return (_jitted_packed(self._donate)[0] if self.traversal == "packed"
+                else _jitted(self._donate)[0])
+
+    def _binned_fn(self):
+        return (_jitted_packed(self._donate)[1] if self.traversal == "packed"
+                else _jitted(self._donate)[1])
+
+    def _raw_args(self) -> tuple:
+        """Model-side arguments of the raw-input executable (after the
+        donated batch buffer)."""
+        b = self.bundle
+        if self.traversal == "packed":
+            return (b.thr_table, b.node_w0, b.node_w1,
+                    jnp.asarray(b.max_depth, jnp.int32))
+        return (b.thr_table,) + b.device_args()
+
+    def _binned_args(self) -> tuple:
+        b = self.bundle
+        if self.traversal == "packed":
+            return (b.node_w0, b.node_w1, jnp.asarray(b.max_depth, jnp.int32))
+        return b.device_args()
 
     # ------------------------------------------------------------ leaves
 
@@ -450,24 +619,25 @@ class PredictEngine:
         n = xc.shape[0]
         nb = self._bucket_rows(n)
         bundle = self.bundle
-        raw_fn, binned_fn = _jitted(self._donate)
         path = "raw" if f32_safe else "binned"
         with self.timers.phase("predict_bin"):
             if f32_safe:
                 xp = np.zeros((nb, max(bundle.num_cols, 1)), np.float32)
                 xp[:n, :bundle.num_cols] = xc.astype(np.float32)
-                dev_in = (jax.device_put(xp), bundle.thr_table)
+                dev_in = (jax.device_put(xp),) + self._raw_args()
+                fn = self._raw_fn()
             else:
                 bins, cats, nanm, zerom = bundle.bin_host(xc)
                 pad = ((0, nb - n), (0, max(bundle.num_cols, 1) - xc.shape[1]))
                 dev_in = tuple(jax.device_put(np.pad(a, pad))
-                               for a in (bins, cats, nanm, zerom))
+                               for a in (bins, cats, nanm, zerom)) \
+                    + self._binned_args()
+                fn = self._binned_fn()
         with self.timers.phase("predict_traverse"):
-            fn = raw_fn if f32_safe else binned_fn
-            leaves = fn(*dev_in, *bundle.device_args())
+            leaves = fn(*dev_in)
             out = np.asarray(leaves)[:bundle.num_trees, :n]
         obs_counters.inc("predict_dispatch", bucket=nb, path=path,
-                         exec=bundle.exec_id())
+                         traversal=self.traversal, exec=bundle.exec_id())
         obs_counters.gauge("predict_jit_entries", jit_entries())
         return out
 

@@ -143,7 +143,15 @@ def subset_histogram_segment(rows: jnp.ndarray, g: jnp.ndarray,
         return acc + part, None
 
     acc0 = jnp.zeros((f * num_bins, NUM_STATS), dtype=w.dtype)
-    hist, _ = lax.scan(body, acc0, (rows_c, w_c))
+    if n_chunks == 1:
+        # single-chunk windows (every sub-2048-row bucket of the deep-tree
+        # tail): the scan machinery is pure overhead — unroll it.  The
+        # ``acc0 +`` is kept so the float results stay bit-identical to
+        # the scanned form (dropping it would turn a -0.0 bin sum into
+        # the raw part's -0.0 vs the scan's 0.0 + -0.0 == 0.0).
+        hist, _ = body(acc0, (rows_c[0], w_c[0]))
+    else:
+        hist, _ = lax.scan(body, acc0, (rows_c, w_c))
     return hist.reshape(f, num_bins, NUM_STATS)
 
 

@@ -46,6 +46,12 @@ class SplitConfig(NamedTuple):
     cat_smooth_ratio: float = 0.01
     min_cat_smooth: float = 5.0
     max_cat_smooth: float = 100.0
+    split_find: str = "chain"       # static: fused (per-direction reductions
+    #                                 straight off the hot histogram — no
+    #                                 packed [F, 2B, 4] candidate arrays) |
+    #                                 chain (the historical pack+argmax
+    #                                 formulation, the forced A/B baseline).
+    #                                 Both produce bit-identical SplitResults.
 
 
 class SplitResult(NamedTuple):
@@ -326,6 +332,183 @@ def _categorical_candidates(hist, parent_g, parent_h, parent_c,
             l1, l2)
 
 
+class FusedSplitCtx(NamedTuple):
+    """Loop-invariant precomputation of the fused split-find scan.
+
+    Every field depends only on feature metadata + static config — constant
+    across a tree's ~L splits — so the grower builds it ONCE per grow call
+    (strategy ``setup``) and the while body stops re-deriving the bin iota
+    and keep/candidate masks every split the way the chain formulation
+    does.  ``keep_p1``/``cand_p1``/``force_right`` are ``None`` when the
+    dataset has no missing values (the dir=+1 scan is statically skipped,
+    exactly like the chain path)."""
+    bins: jnp.ndarray           # [F, B] i32 bin iota
+    keep_m1: jnp.ndarray        # [F, B] bool: bins feeding the dir=-1 scan
+    cand_m1: jnp.ndarray        # [F, B] bool: dir=-1 candidacy (sans
+    #                             feat_valid, which changes per leaf)
+    keep_p1: jnp.ndarray        # [F, B] bool | None
+    cand_p1: jnp.ndarray        # [F, B] bool | None
+    force_right: jnp.ndarray    # [F] bool | None: 2-bin NaN features
+    #                             always default right
+
+
+def make_fused_ctx(num_bin, missing_type, default_bin, num_bins: int,
+                   cfg: SplitConfig) -> FusedSplitCtx:
+    """Build the loop-invariant fused-scan masks (same boolean algebra as
+    ``_candidate_arrays`` — booleans are exact, so hoisting them out of the
+    loop body is trivially bit-neutral)."""
+    f = num_bin.shape[0]
+    b = num_bins
+    bins = lax.broadcasted_iota(jnp.int32, (f, b), 1)
+    nb = num_bin[:, None]
+    mt = missing_type[:, None]
+    db = default_bin[:, None]
+    nan_bin = nb - 1
+    two_dir = (nb > 2) & (mt != MISSING_NONE)
+    na_excl = two_dir & (mt == MISSING_NAN)
+    zero_skip = two_dir & (mt == MISSING_ZERO)
+    keep_m1 = ~((zero_skip & (bins == db)) | (na_excl & (bins == nan_bin)))
+    cand_m1 = ((bins <= nb - 2 - na_excl.astype(jnp.int32))
+               & ~(zero_skip & (bins == db - 1)))
+    if not cfg.has_missing:
+        return FusedSplitCtx(bins, keep_m1, cand_m1, None, None, None)
+    keep_p1 = ~(zero_skip & (bins == db))
+    cand_p1 = two_dir & (bins <= nb - 2) & ~(zero_skip & (bins == db))
+    force_right = (num_bin <= 2) & (missing_type == MISSING_NAN)
+    return FusedSplitCtx(bins, keep_m1, cand_m1, keep_p1, cand_p1,
+                         force_right)
+
+
+def _fused_numerical(hist, parent_g, parent_h, parent_c,
+                     num_bin, missing_type, default_bin, feat_valid,
+                     cfg: SplitConfig, feature_base, ctx: FusedSplitCtx):
+    """Fused best-split scan: per-direction reductions straight off the
+    (still hot) histogram, emitting only the winning ``SplitResult`` —
+    the packed ``[F, 2B, 4]`` candidate array, its flip/concat assembly,
+    and the candidate-order ``thr``/``is_m1`` tables of the chain path
+    never materialize.
+
+    Bit-identity with the chain: every float value entering the selection
+    (the masked cumulative sums and ``eval_candidates`` gain algebra) is
+    computed by the SAME primitive sequence; only the selection is
+    restructured — per-direction row argmax (over the dir=-1 gains
+    REVERSED, preserving the largest-threshold-first tie-break) combined
+    by the exact packed-order priority (dir=-1 block before dir=+1,
+    smallest feature index first), which is equivalent to the chain's
+    first-max flat argmax candidate for candidate.
+
+    Returns ``(SplitResult, per_feature_ok [F])``."""
+    dtype = hist.dtype
+    f, b, _ = hist.shape
+    if ctx is None:
+        ctx = make_fused_ctx(num_bin, missing_type, default_bin, b, cfg)
+
+    l1 = jnp.asarray(cfg.lambda_l1, dtype)
+    l2 = jnp.asarray(cfg.lambda_l2, dtype)
+    min_data = jnp.asarray(cfg.min_data_in_leaf, dtype)
+    min_hess = jnp.asarray(cfg.min_sum_hessian_in_leaf, dtype)
+    tot_h = parent_h + 2.0 * K_EPSILON
+    gain_shift = leaf_split_gain(parent_g, tot_h, l1, l2)
+    min_gain_shift = gain_shift + cfg.min_gain_to_split
+    neg_inf = jnp.asarray(-jnp.inf, dtype)
+
+    def eval_gains(left_g, left_h, left_c, cand):
+        # identical arithmetic to the chain's eval_candidates
+        right_g = parent_g - left_g
+        right_h = tot_h - left_h
+        right_c = parent_c - left_c
+        ok = (cand
+              & (left_c >= min_data) & (right_c >= min_data)
+              & (left_h >= min_hess) & (right_h >= min_hess))
+        gain = (leaf_split_gain(left_g, left_h, l1, l2)
+                + leaf_split_gain(right_g, right_h, l1, l2))
+        ok = ok & (gain > min_gain_shift)
+        return jnp.where(ok, gain, neg_inf)
+
+    # ---- dir = -1 : accumulate from the right; missing defaults LEFT ----
+    # without missing values no bin is ever excluded (two_dir is all-False
+    # so keep_m1 is all-True) — the masking select is the identity and is
+    # statically skipped (where(True, hist, 0) == hist bit for bit)
+    kept = (jnp.where(ctx.keep_m1[:, :, None], hist, 0.0)
+            if cfg.has_missing else hist)
+    right_m1 = (jnp.sum(kept, axis=1, keepdims=True)
+                - jnp.cumsum(kept, axis=1))
+    lg_m1 = parent_g - right_m1[:, :, 0]
+    lh_m1 = tot_h - (right_m1[:, :, 1] + K_EPSILON)
+    lc_m1 = parent_c - right_m1[:, :, 2]
+    gains_m1 = eval_gains(lg_m1, lh_m1, lc_m1,
+                          feat_valid[:, None] & ctx.cand_m1)
+    # chain order puts dir=-1 candidates largest-threshold-first: the row
+    # argmax over the REVERSED gains is exactly that order's first max
+    flipped_m1 = gains_m1[:, ::-1]
+    jm = jnp.argmax(flipped_m1, axis=1)
+    gm = jnp.max(flipped_m1, axis=1)
+
+    if cfg.has_missing:
+        # ---- dir = +1 : accumulate from the left; missing defaults RIGHT
+        kept = jnp.where(ctx.keep_p1[:, :, None], hist, 0.0)
+        left_p1 = jnp.cumsum(kept, axis=1)
+        lg_p1 = left_p1[:, :, 0]
+        lh_p1 = left_p1[:, :, 1] + K_EPSILON
+        lc_p1 = left_p1[:, :, 2]
+        gains_p1 = eval_gains(lg_p1, lh_p1, lc_p1,
+                              feat_valid[:, None] & ctx.cand_p1)
+        jp = jnp.argmax(gains_p1, axis=1)
+        gp = jnp.max(gains_p1, axis=1)
+        best_f = jnp.maximum(gm, gp)     # per-feature winner, dir=-1 first
+    else:
+        best_f = gm
+
+    # smallest feature index wins ties — argmax's first-max, like the
+    # chain's feature-major flat argmax
+    fi = jnp.argmax(best_f).astype(jnp.int32)
+    best_gain = best_f[fi]
+    found = best_gain > neg_inf
+
+    bin_m1 = (b - 1 - jm[fi]).astype(jnp.int32)
+    if cfg.has_missing:
+        use_m1 = gm[fi] >= gp[fi]        # ties: dir=-1 precedes dir=+1
+        pos_p1 = jp[fi].astype(jnp.int32)
+        threshold = jnp.where(use_m1, bin_m1, pos_p1)
+        left_sum_g = jnp.where(use_m1, lg_m1[fi, bin_m1], lg_p1[fi, pos_p1])
+        left_sum_h_raw = jnp.where(use_m1, lh_m1[fi, bin_m1],
+                                   lh_p1[fi, pos_p1])
+        left_count = jnp.where(use_m1, lc_m1[fi, bin_m1], lc_p1[fi, pos_p1])
+        default_left = jnp.where(found, use_m1, True)
+        # 2-bin NaN features always default right (chain _result_from_index)
+        default_left = jnp.where(found & ctx.force_right[fi], False,
+                                 default_left)
+    else:
+        threshold = bin_m1
+        left_sum_g = lg_m1[fi, bin_m1]
+        left_sum_h_raw = lh_m1[fi, bin_m1]
+        left_count = lc_m1[fi, bin_m1]
+        default_left = jnp.ones((), bool)   # chain: is_m1 always True here
+
+    right_sum_g = parent_g - left_sum_g
+    right_sum_h_raw = tot_h - left_sum_h_raw
+    right_count = parent_c - left_count
+
+    res = SplitResult(
+        found=found,
+        gain=jnp.where(found, best_gain - min_gain_shift, neg_inf),
+        feature=jnp.where(found, fi + feature_base, -1),
+        threshold=jnp.where(found, threshold, 0).astype(jnp.int32),
+        default_left=default_left,
+        left_sum_g=left_sum_g,
+        left_sum_h=left_sum_h_raw - K_EPSILON,
+        left_count=left_count,
+        right_sum_g=right_sum_g,
+        right_sum_h=right_sum_h_raw - K_EPSILON,
+        right_count=right_count,
+        left_output=leaf_output(left_sum_g, left_sum_h_raw, l1, l2),
+        right_output=leaf_output(right_sum_g, right_sum_h_raw, l1, l2),
+        is_cat=jnp.zeros((), bool),
+        cat_bins=jnp.zeros((b,), bool),
+    )
+    return res, best_f > neg_inf
+
+
 def _result_from_index(idx, packed, thr, is_m1,
                        parent_g, parent_c, num_bin, missing_type,
                        min_gain_shift, tot_h, l1, l2, nf, b, feature_base=0):
@@ -428,7 +611,8 @@ def best_split(hist: jnp.ndarray,
                num_bin: jnp.ndarray, missing_type: jnp.ndarray,
                default_bin: jnp.ndarray, feat_valid: jnp.ndarray,
                cfg: SplitConfig, feature_base: int = 0,
-               is_cat: jnp.ndarray = None, with_feat_ok: bool = False):
+               is_cat: jnp.ndarray = None, with_feat_ok: bool = False,
+               fused_ctx: FusedSplitCtx = None):
     """Best split (numerical or categorical) across all features of one leaf.
 
     hist: [F, B, 3] (sum_g, sum_h, count); num_bin/missing_type/default_bin:
@@ -443,10 +627,28 @@ def best_split(hist: jnp.ndarray,
     features whose parent leaf had no such candidate from the entire
     subtree (serial_tree_learner.cpp:406-417), so the grower records
     these flags per leaf and gates children's scans with them.
+
+    ``cfg.split_find`` selects the numerical-scan formulation: ``fused``
+    (per-direction reductions, no packed candidate arrays; optionally fed
+    the loop-invariant ``fused_ctx`` the grower hoists) or ``chain`` (the
+    historical pack+argmax form).  Both are bit-identical — pinned in
+    tests/test_split_find.py; the categorical scan is shared.
     """
     f, b, _ = hist.shape
     use_cat = cfg.has_categorical and is_cat is not None
     num_valid = feat_valid & ~is_cat if use_cat else feat_valid
+    if cfg.split_find == "fused":
+        num_res, num_ok = _fused_numerical(
+            hist, parent_g, parent_h, parent_c, num_bin, missing_type,
+            default_bin, num_valid, cfg, feature_base, fused_ctx)
+        if not use_cat:
+            if with_feat_ok:
+                return num_res, num_ok
+            return num_res
+        return _combine_categorical(
+            hist, num_res, num_ok, parent_g, parent_h, parent_c, num_bin,
+            missing_type, is_cat, feat_valid, cfg, feature_base, f, b,
+            with_feat_ok)
     (packed, thr, is_m1,
      min_gain_shift, tot_h, l1, l2) = _candidate_arrays(
         hist, parent_g, parent_h, parent_c, num_bin, missing_type,
@@ -461,7 +663,21 @@ def best_split(hist: jnp.ndarray,
         if with_feat_ok:
             return num_res, jnp.max(gains, axis=1) > -jnp.inf
         return num_res
+    return _combine_categorical(
+        hist, num_res, jnp.max(gains, axis=1) > -jnp.inf, parent_g,
+        parent_h, parent_c, num_bin, missing_type, is_cat, feat_valid, cfg,
+        feature_base, f, b, with_feat_ok)
 
+
+def _combine_categorical(hist, num_res, num_ok, parent_g, parent_h, parent_c,
+                         num_bin, missing_type, is_cat, feat_valid,
+                         cfg: SplitConfig, feature_base, f, b, with_feat_ok):
+    """Categorical scan + numerical-vs-categorical combine, shared by the
+    chain and fused numerical paths (the categorical candidate machinery is
+    identical either way)."""
+    dtype = hist.dtype
+    l1 = jnp.asarray(cfg.lambda_l1, dtype)
+    l2 = jnp.asarray(cfg.lambda_l2, dtype)
     (cgains, clg, clh, clc, cpos, cp1, order, used_bin,
      c_shift, c_tot_h, _, _) = _categorical_candidates(
         hist, parent_g, parent_h, parent_c, num_bin, is_cat, feat_valid,
@@ -481,8 +697,7 @@ def best_split(hist: jnp.ndarray,
     res = jax.tree.map(lambda a, c: jnp.where(pick_cat, c, a),
                        num_res, cat_res)
     if with_feat_ok:
-        ok = jnp.where(is_cat, jnp.max(cgains, axis=1) > -jnp.inf,
-                       jnp.max(gains, axis=1) > -jnp.inf)
+        ok = jnp.where(is_cat, jnp.max(cgains, axis=1) > -jnp.inf, num_ok)
         return res, ok
     return res
 

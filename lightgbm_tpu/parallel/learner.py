@@ -237,7 +237,10 @@ class VotingStrategy(SerialStrategy):
     # the grower is therefore performed in each shard's local space.
 
     def find(self, ctx, hist_child, pg, ph, pc, feat_ok):
-        meta, feat_valid, maps = ctx
+        # the voting scan runs on a SLICED feature subset, so the serial
+        # strategy's full-width fused ctx does not apply (best_split
+        # derives the masks inline on the fused path)
+        meta, feat_valid, maps, _ = ctx
         feat_valid = feat_valid & feat_ok
         scfg = self.cfg.split_config()
         if maps is not None:

@@ -198,11 +198,35 @@ class Predictor:
 
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
         """Raw margin scores [K, N]; routed through the attached serving
-        engine when one exists (bit-identical, pinned)."""
-        if self.engine is not None and not self.early_stop:
+        engine when one exists (bit-identical, pinned).  Early-stopped
+        requests slice the cached SoA bundle too: ONE batched device
+        traversal yields every (tree, row) leaf, and the margin
+        accumulation below replays the reference early-stop loop exactly
+        (same f64 leaf tables, same per-iteration adds over the same
+        active rows), so the output is bit-identical to
+        :meth:`predict_raw_trees` while the per-tree host traversal loop
+        never runs."""
+        if self.engine is None:
+            return self.predict_raw_trees(X)
+        if not self.early_stop:
             return self.engine.raw_scores(X,
                                           num_trees=self.num_iteration * self.k)
-        return self.predict_raw_trees(X)
+        leaves = self.engine.leaves(X)                     # [T, N]
+        lv = self.engine.bundle.leaf_value                 # [Tp, P+1] f64
+        n = leaves.shape[1]
+        out = np.zeros((self.k, n), dtype=np.float64)
+        active = np.ones(n, dtype=bool)
+        for it in range(self.num_iteration):
+            if not active.any():
+                break
+            idx = np.nonzero(active)[0]
+            for k in range(self.k):
+                t = it * self.k + k
+                out[k, idx] += lv[t][leaves[t, idx]]
+            if (it + 1) % self.early_stop_freq == 0:
+                margin = self._margin(out[:, idx])
+                active[idx[margin >= self.early_stop_margin]] = False
+        return out
 
     def predict_raw_trees(self, X: np.ndarray) -> np.ndarray:
         """The per-tree host traversal loop — the bit-exactness oracle the

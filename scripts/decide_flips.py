@@ -49,10 +49,18 @@ FLIPS = [
     ("bench_sparse_nopack.json", "enable_bin_packing=false",
      "flip packing default off on TPU if OFF wins",
      "bench_sparse.json"),
+    # INVERTED pair like the gen-1 one: bench_leaves_fused.json carries the
+    # default (split_find=fused), the chain artifact is the forced
+    # baseline — LOSE here means the fused split-find won on-chip and the
+    # default stands; a WIN >= 5% means the chain must come back on TPU
+    ("bench_leaves_chain.json", "split_find=chain (forced baseline)",
+     "if this WINS >=5% over bench_leaves_fused.json, flip split_find "
+     "fused->chain on TPU (config.py) — otherwise the fused scan stands",
+     "bench_leaves_fused.json"),
 ]
 COVERAGE = ["bench_1m_63bin.json", "bench_higgs_full.json",
             "bench_wide.json", "bench_sparse.json", "bench_leaves.json",
-            "bench_serving.json"]
+            "bench_leaves_fused.json", "bench_serving.json"]
 
 
 def load(path):
@@ -117,6 +125,19 @@ def memory_row(d):
             f"{f', capacity {cap_b / 1e9:.1f} GB' if cap_b else ''})")
 
 
+def observed_split_find(d):
+    """Dominant split_find identity the child's telemetry traced
+    (bench.py embeds the grower's split_find_dispatch counter)."""
+    counts = (d.get("telemetry") or {}).get("split_find_dispatch") or {}
+    best, best_n = None, 0
+    for key, n in counts.items():
+        tags = dict(kv.split("=", 1) for kv in key.split(",") if "=" in kv)
+        impl = tags.get("impl")
+        if impl and n > best_n:
+            best, best_n = impl, n
+    return best
+
+
 def serving_row(d):
     """One-line serving-rung summary of an artifact's "serving" block
     (bench.py `_serving_rung`, docs/SERVING.md): chosen backend, the
@@ -127,7 +148,8 @@ def serving_row(d):
     if not isinstance(s, dict) or "error" in s:
         return None
     b4 = (s.get("buckets") or {}).get("4096", {})
-    return (f"serving[{s.get('backend')}]: 4096-row p50 "
+    trav = f"/{s['traversal']}" if s.get("traversal") else ""
+    return (f"serving[{s.get('backend')}{trav}]: 4096-row p50 "
             f"{b4.get('p50_ms')} ms / {b4.get('qps')} rows/s "
             f"({s.get('speedup_vs_predict_loop')}x the predict loop), "
             f"{s.get('predict_jit_entries')} jit entries, "
@@ -168,8 +190,11 @@ def main():
                   f"{' DEGRADED' if 'degraded' in d else ''}")
             ls = d.get("leaves_sweep")
             if isinstance(ls, dict) and "marginal_ms_per_leaf" in ls:
+                ab = (f", chain A/B {ls['chain_marginal_ms_per_leaf']}"
+                      if "chain_marginal_ms_per_leaf" in ls else "")
                 print(f"{'':53}deep-tree fixed cost: "
                       f"{ls['marginal_ms_per_leaf']} ms/leaf "
+                      f"[{ls.get('split_find', 'fused')}]{ab} "
                       f"({ls['leaves'][0]} vs {ls['leaves'][1]} leaves at "
                       f"{ls['rows']} rows; round-7 CPU pre/post was "
                       f"11.5 -> ~3.4)")
@@ -190,6 +215,18 @@ def main():
         ok, lk = observed_kernel(d), label_kernel(d)
         if d.get("kernel_mismatch") or (ok and lk and ok != lk):
             flags += f" KERNEL-MISMATCH(label {lk}, observed {ok})"
+        # the split-find A/B pair must each carry their advertised scan
+        # identity (telemetry split_find_dispatch) or the pair decides
+        # nothing — same honesty rule as the histogram-kernel label
+        if fname.startswith("bench_leaves_"):
+            want = "chain" if "chain" in fname else "fused"
+            seen = observed_split_find(d)
+            if seen is not None and seen != want:
+                flags += f" SPLIT-FIND-MISMATCH(label {want}, observed " \
+                         f"{seen})"
+                print(f"{fname:34} {d['value']:>9} {'—':>8} {flags}: "
+                      f"no decision ({knob})")
+                continue
         if not deciding or not clean_tpu(d) or not clean_tpu(base):
             print(f"{fname:34} {d['value']:>9} {'—':>8}  "
                   f"platform {platform(d)}{flags}: not a clean TPU pair, "

@@ -69,6 +69,11 @@ def main():
                     help="segment (CPU default) | einsum | fused | pallas")
     ap.add_argument("--bucket-min-log2", type=int, default=None,
                     help="override cfg.bucket_min_log2 (floor A/B)")
+    ap.add_argument("--split-find", default="fused",
+                    help="best-split scan: fused (default) | chain "
+                         "(forced round-7 baseline)")
+    ap.add_argument("--has-missing", action="store_true",
+                    help="trace the two-direction scan (missing values)")
     args = ap.parse_args()
 
     import jax
@@ -94,6 +99,8 @@ def main():
         return GrowerConfig(num_leaves=leaves, min_data_in_leaf=1,
                             min_sum_hessian_in_leaf=100.0, max_bin=b,
                             hist_method=args.hist_method,
+                            split_find=args.split_find,
+                            has_missing=args.has_missing,
                             hist_interpret=args.hist_method == "fused"
                             and jax.devices()[0].platform != "tpu", **kw)
 
@@ -163,6 +170,7 @@ def main():
         sys.stderr.write(f"marginal {lo}->{hi}: {mlh:.3f} ms/leaf\n")
 
     # ---- 3. loop-body jaxpr audit -------------------------------------
+    from lightgbm_tpu.utils.jaxpr_audit import find_while_body
     jaxpr = jax.make_jaxpr(make_grower(cfg_for(L)))(*dev)
     big = audit_loop_body(jaxpr, min_elems=min(n, b * f * L))
     inventory = [{"prim": r["prim"],
@@ -172,6 +180,68 @@ def main():
     sys.stderr.write("loop-body ops with O(N) / O(L*F*B) operands:\n")
     for r in inventory:
         sys.stderr.write(f"  {r['prim']:24s} {r['shapes']}\n")
+    body = find_while_body(jaxpr)
+    result["loop_body_eqns"] = len(body.eqns)
+    obs_counters.gauge("grow_body_eqns", len(body.eqns))
+    sys.stderr.write(f"loop-body top-level eqns: {len(body.eqns)} "
+                     f"(split_find={args.split_find})\n")
+
+    # ---- 3b. split-find chain inventory (round-8 evidence artifact) ----
+    # op count + bytes materialized by the best-split scan alone, at the
+    # in-loop shape (the vmapped pair of children), chain vs fused — the
+    # before/after decomposition docs/PERF.md round 8 cites
+    from lightgbm_tpu.ops.split import SplitConfig, best_split
+
+    def find_inventory(impl):
+        scfg = SplitConfig(min_data_in_leaf=1,
+                           min_sum_hessian_in_leaf=100.0,
+                           has_missing=args.has_missing, split_find=impl)
+        num_bin = jnp.full((f,), b, jnp.int32)
+        zeros = jnp.zeros((f,), jnp.int32)
+        fv = jnp.ones((f,), bool)
+
+        def pair_find(h2, pg, ph, pc):
+            return jax.vmap(lambda hh, a, b_, c_: best_split(
+                hh, a, b_, c_, num_bin, zeros, zeros, fv, scfg,
+                with_feat_ok=True))(h2, pg, ph, pc)
+
+        h2 = jax.ShapeDtypeStruct((2, f, b, 3), jnp.float32)
+        s2 = jax.ShapeDtypeStruct((2,), jnp.float32)
+        jx = jax.make_jaxpr(pair_find)(h2, s2, s2, s2)
+
+        def walk(jaxpr):
+            eqns, bytes_ = 0, 0
+            for e in jaxpr.eqns:
+                eqns += 1
+                for v in e.outvars:
+                    aval = getattr(v, "aval", None)
+                    if aval is not None and getattr(aval, "shape", None) \
+                            is not None:
+                        sz = 1
+                        for d in aval.shape:
+                            sz *= int(d)
+                        bytes_ += sz * aval.dtype.itemsize
+                for val in e.params.values():
+                    vals = val if isinstance(val, (list, tuple)) else [val]
+                    for s in vals:
+                        sub = getattr(s, "jaxpr", None)
+                        if sub is not None and hasattr(sub, "eqns"):
+                            se, sb = walk(sub)
+                            eqns += se
+                            bytes_ += sb
+            return eqns, bytes_
+
+        eqns, bytes_ = walk(jx.jaxpr)
+        return {"eqns": eqns, "bytes_materialized": bytes_}
+
+    result["split_find"] = {impl: find_inventory(impl)
+                            for impl in ("chain", "fused")}
+    for impl, inv in result["split_find"].items():
+        obs_counters.gauge(f"split_find_{impl}_eqns", inv["eqns"])
+        sys.stderr.write(
+            f"split-find[{impl}]: {inv['eqns']} eqns, "
+            f"{inv['bytes_materialized'] / 1e6:.2f} MB materialized per "
+            f"pair-find\n")
 
     # ---- 4. compiled-executable memory analysis -----------------------
     from lightgbm_tpu.obs import memory as obs_memory

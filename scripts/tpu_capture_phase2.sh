@@ -119,6 +119,29 @@ memsnap "leaves"
 snap "leaves sweep"
 
 alive_or_abort "leaves sweep"
+echo "== fused split-find A/B (leaves sweep, fused vs forced-chain) ==" \
+    | tee -a "$OUT/log.txt"
+# round 8: the best-split scan fused onto the histogram (split_find=fused,
+# the default) against the forced chain baseline — settles fused split-find
+# on-chip alongside the fused-histogram A/B.  Both artifacts carry the
+# split_find_dispatch telemetry so decide_flips can reject a mislabeled
+# pair; BENCH_LEAVES_AB=0 keeps each child single-identity (the A/B is the
+# artifact PAIR, not the in-rung twin).
+BENCH_TRACE="$OUT/trace_leaves_fused.jsonl" \
+BENCH_LEAVES_SWEEP=1 BENCH_LEAVES_AB=0 BENCH_TREES=4 \
+    BENCH_EXTRA_PARAMS=split_find=fused \
+    BENCH_STAGE_TIMEOUT=1500 timeout 1800 python bench.py \
+    > "$OUT/bench_leaves_fused.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_leaves_fused.json" | tee -a "$OUT/log.txt"
+BENCH_TRACE="$OUT/trace_leaves_chain.jsonl" \
+BENCH_LEAVES_SWEEP=1 BENCH_LEAVES_AB=0 BENCH_TREES=4 \
+    BENCH_EXTRA_PARAMS=split_find=chain \
+    BENCH_STAGE_TIMEOUT=1500 timeout 1800 python bench.py \
+    > "$OUT/bench_leaves_chain.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_leaves_chain.json" | tee -a "$OUT/log.txt"
+snap "split-find A/B"
+
+alive_or_abort "split-find A/B"
 echo "== serving rung (SoA microbatch engine: latency/QPS + recompile pin) ==" \
     | tee -a "$OUT/log.txt"
 # the high-QPS inference micro-rung (docs/SERVING.md) ON-CHIP: p50/p99 +

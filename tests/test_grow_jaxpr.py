@@ -35,9 +35,10 @@ from lightgbm_tpu.utils.jaxpr_audit import audit_loop_body
 N, F, B, L = 32768, 8, 64, 15
 
 
-def _grow_and_args():
+def _grow_and_args(split_find="fused", has_missing=True):
     cfg = GrowerConfig(num_leaves=L, min_data_in_leaf=1, max_bin=B,
-                       hist_method="segment")
+                       hist_method="segment", split_find=split_find,
+                       has_missing=has_missing)
     meta = FeatureMeta(
         num_bin=jnp.full((F,), B, jnp.int32),
         missing_type=jnp.zeros((F,), jnp.int32),
@@ -51,14 +52,17 @@ def _grow_and_args():
     return make_grower(cfg), args
 
 
-def test_loop_body_has_no_unsanctioned_big_ops():
-    grow, args = _grow_and_args()
+@pytest.mark.parametrize("split_find", ["fused", "chain"])
+def test_loop_body_has_no_unsanctioned_big_ops(split_find):
+    grow, args = _grow_and_args(split_find)
     jaxpr = jax.make_jaxpr(grow)(*args)
     store_elems = L * F * B * 3
 
     # O(N) audit: find-pair candidate arrays ([2, F, 2B, 4] = 8192 elems)
     # sit well under N, a stale-leaf rescan ([L, F, 2B, 4] = 61440) well
-    # over it — the threshold separates the two by construction
+    # over it — the threshold separates the two by construction.  The
+    # fused scan's widest arrays ([2, F, B, 3]) sit under the chain's, so
+    # the same threshold pins both formulations.
     assert 4 * L * F * 2 * B > N > 4 * 2 * F * 2 * B
     big = audit_loop_body(jaxpr, min_elems=N)
     prims = {r["prim"] for r in big}
@@ -75,6 +79,72 @@ def test_loop_body_has_no_unsanctioned_big_ops():
     assert store_prims == ["dynamic_slice", "scatter"], (
         f"hist_store must be touched by exactly one dynamic_slice read "
         f"and one scatter pair-write; got {store}")
+
+
+# every traced transfer/callback primitive jax can put in a jaxpr — a
+# per-split host round-trip inside the grow loop would appear as one of
+# these (the round-8 device-resident-frontier contract)
+_HOST_PRIMS = ("callback", "infeed", "outfeed", "host_callback",
+               "device_put", "debug_print")
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            vals = val if isinstance(val, (list, tuple)) else [val]
+            for v in vals:
+                sub = getattr(v, "jaxpr", None)
+                if sub is not None and hasattr(sub, "eqns"):
+                    yield from _walk_eqns(sub)
+                elif hasattr(v, "eqns"):
+                    yield from _walk_eqns(v)
+
+
+@pytest.mark.parametrize("split_find", ["fused", "chain"])
+def test_loop_body_has_no_host_transfers(split_find):
+    """The whole frontier stays device-resident: no callback / infeed /
+    outfeed / transfer primitive may appear anywhere in the loop body
+    (including the switch branches) — the only per-tree device_get is the
+    final tree pull boosting already does, OUTSIDE the loop."""
+    from lightgbm_tpu.utils.jaxpr_audit import find_while_body
+    grow, args = _grow_and_args(split_find)
+    body = find_while_body(jax.make_jaxpr(grow)(*args))
+    bad = [e.primitive.name for e in _walk_eqns(body)
+           if any(t in e.primitive.name for t in _HOST_PRIMS)]
+    assert not bad, (
+        f"grow-loop body contains host-transfer primitives {bad} — a "
+        f"per-split host round-trip has been reintroduced")
+
+
+# ---- loop-body size ratchet ------------------------------------------------
+#
+# On XLA:CPU the deep-tree tail is op-DISPATCH bound: the per-split fixed
+# cost tracks the body's post-fusion thunk count, for which the traced
+# equation count is the stable jaxpr-level proxy (docs/PERF.md round 8).
+# Measured on jax 0.4.37 at this shape: 414 top-level eqns for the fused
+# no-missing body (the bench regime; the chain body is 459), 527 with the
+# missing direction on (more but individually narrower eqns than the
+# chain's 523 — the packed [F, 2B, 4] arrays are gone either way).  The
+# ratchets leave ~15% headroom for toolchain drift but fail on a
+# structural regression: per-field pool/tree scatters, a de-hoisted mask
+# chain, or per-split host work are each worth 30+ eqns.  If a jax
+# upgrade legitimately moves the count, re-measure and ratchet
+# deliberately.
+
+BODY_EQNS_BUDGET = {False: 480, True: 610}
+
+
+@pytest.mark.parametrize("has_missing", [False, True])
+def test_fused_body_eqn_count_within_budget(has_missing):
+    from lightgbm_tpu.utils.jaxpr_audit import find_while_body
+    grow, args = _grow_and_args("fused", has_missing=has_missing)
+    body = find_while_body(jax.make_jaxpr(grow)(*args))
+    n_eqns = len(body.eqns)
+    assert n_eqns <= BODY_EQNS_BUDGET[has_missing], (
+        f"fused grow-loop body has {n_eqns} top-level eqns "
+        f"(budget {BODY_EQNS_BUDGET[has_missing]}, has_missing="
+        f"{has_missing}) — per-split fixed dispatch cost has re-widened")
 
 
 def test_compiled_body_has_no_full_pool_copies():

@@ -431,3 +431,118 @@ def test_serving_http_surface(binary_model, test_rows):
         if "srv" in httpd_box:
             httpd_box["srv"].shutdown()
         srv.stop()
+
+
+# ---- round 8: packed-node-word traversal + engine-side num_iteration /
+#      early-stop predict ---------------------------------------------------
+
+
+def test_packed_traversal_bit_identical(binary_model, test_rows):
+    """serving_traversal=packed (folded node words + fixed-depth fori
+    ladder) must produce byte-identical raw margins to the classic
+    traversal AND to the per-tree host loop — incl. NaN default-direction
+    rows (the fixture trains with missing values)."""
+    p = Predictor(binary_model.models, binary_model.num_class)
+    want = p.predict_raw_trees(test_rows)
+    eng = PredictEngine(binary_model.models, binary_model.num_class,
+                        backend="xla", traversal="packed")
+    assert eng.traversal == "packed"
+    assert binary_model.models[-1].max_depth() <= eng.bundle.max_depth
+    got = eng.raw_scores(test_rows)
+    np.testing.assert_array_equal(
+        np.asarray(got).view(np.uint64), np.asarray(want).view(np.uint64))
+    # leaf indices agree with the classic traversal too
+    xla = PredictEngine(binary_model.models, binary_model.num_class,
+                        backend="xla", traversal="xla")
+    np.testing.assert_array_equal(eng.leaves(test_rows),
+                                  xla.leaves(test_rows))
+
+
+def test_packed_traversal_auto_on_cpu(binary_model):
+    """'auto' resolves to packed on the CPU backend for packable models
+    (the measured XLA:CPU gather-lowering headroom)."""
+    eng = PredictEngine(binary_model.models, binary_model.num_class,
+                        backend="xla", traversal="auto")
+    assert eng.traversal == "packed"
+
+
+def test_packed_traversal_degrades_loudly_on_categorical():
+    """A categorical ensemble cannot fold into the node-word budget: an
+    explicit packed request must fall back to xla with a structured
+    layout_downgrade event, never crash or mislabel."""
+    rng = np.random.RandomState(4)
+    X = rng.randn(600, 5)
+    X[:, 1] = rng.randint(0, 12, 600)
+    y = ((X[:, 0] + (X[:, 1] % 3 == 1)) > 0)
+    booster = _train({"objective": "binary", "num_leaves": 15,
+                      "min_data_in_leaf": 5}, X, y, 6, cat=[1])
+    assert sum(t.num_cat for t in booster.models) > 0
+    obs_counters.reset()
+    eng = PredictEngine(booster.models, booster.num_class, backend="xla",
+                        traversal="packed")
+    assert eng.traversal == "xla"
+    assert eng.bundle.node_w0 is None
+    evs = [e for e in obs_counters.events("layout_downgrade")
+           if e.get("stage") == "serving"]
+    assert evs and evs[0]["requested"] == "serving_traversal=packed"
+    # and the xla fallback still serves correct margins
+    p = Predictor(booster.models, booster.num_class)
+    Xt = rng.randn(40, 5)
+    Xt[:, 1] = rng.randint(0, 12, 40)
+    np.testing.assert_array_equal(np.asarray(eng.raw_scores(Xt)),
+                                  np.asarray(p.predict_raw_trees(Xt)))
+
+
+def test_packed_traversal_zero_recompile_and_dispatch_tag(binary_model,
+                                                          test_rows):
+    """The packed ladder pre-warms like the classic one (no recompiles
+    across a mixed-size replay) and every dispatch is tagged with its
+    traversal identity."""
+    eng = PredictEngine(binary_model.models, binary_model.num_class,
+                        backend="xla", traversal="packed", prewarm=True)
+    warm = jit_entries()
+    obs_counters.reset()
+    rng = np.random.RandomState(3)
+    for s in rng.choice([1, 2, 8, 33, 64, 137], size=25):
+        eng.raw_scores(test_rows[:int(s)])
+    assert jit_entries() == warm
+    tags = obs_counters.get("predict_dispatch")
+    assert tags and all("traversal=packed" in k for k in tags)
+
+
+def test_predict_num_iteration_via_engine(binary_model, test_rows):
+    """Engine-backed predict_raw slices the cached SoA bundle by
+    iteration — parity-pinned against predict_raw_trees(num_iteration=k)
+    for every prefix length."""
+    eng = PredictEngine(binary_model.models, binary_model.num_class,
+                        backend="xla")
+    total = len(binary_model.models)
+    for k in (1, 2, total - 1, total):
+        p = Predictor(binary_model.models, binary_model.num_class,
+                      num_iteration=k, engine=eng)
+        oracle = Predictor(binary_model.models, binary_model.num_class,
+                           num_iteration=k)
+        np.testing.assert_array_equal(
+            np.asarray(p.predict_raw(test_rows)),
+            np.asarray(oracle.predict_raw_trees(test_rows)))
+
+
+def test_predict_early_stop_via_engine(binary_model, test_rows):
+    """Margin-based early stopping no longer falls back to the per-tree
+    host loop: one batched engine traversal + the reference's exact
+    active-row margin accumulation — byte-identical output."""
+    kw = dict(early_stop=True, early_stop_freq=2, early_stop_margin=0.5)
+    oracle = Predictor(binary_model.models, binary_model.num_class, **kw)
+    want = oracle.predict_raw_trees(test_rows)
+    # sanity: the margin gate actually fires at this threshold (otherwise
+    # this pins nothing)
+    plain = Predictor(binary_model.models,
+                      binary_model.num_class).predict_raw_trees(test_rows)
+    assert np.abs(np.asarray(want) - np.asarray(plain)).max() > 0
+    for traversal in ("xla", "packed"):
+        eng = PredictEngine(binary_model.models, binary_model.num_class,
+                            backend="xla", traversal=traversal)
+        p = Predictor(binary_model.models, binary_model.num_class,
+                      engine=eng, **kw)
+        got = p.predict_raw(test_rows)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
