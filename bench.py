@@ -58,6 +58,15 @@ counters) — if it disagrees with the rung label the result is marked
 degraded + kernel_mismatch so decide_flips.py refuses to compare it.
 BENCH_TRACE=<path> additionally writes a Chrome-trace span file for the
 measured child (render: `python -m lightgbm_tpu.obs <path>`).
+
+BENCH_MESH=1 switches the whole run to the ``mesh`` rung (docs/
+DISTRIBUTED.md): GSPMD-vs-shard_map data-parallel training on a FORCED
+8-logical-device host mesh — data/feature/auto (planner) shardings over
+200k x 28 and a feature-wide 2k-column shape, with trees/s and the
+compiled-HLO collective census (op counts + bytes) embedded per
+configuration.  A host-mesh rung by construction (it A/Bs the
+formulations, not chip throughput); the capture playbook banks it as
+``bench_mesh.json``.
 """
 import json
 import os
@@ -351,11 +360,132 @@ def _serving_rung(booster, n_feat, sparsity):
     return out
 
 
+def _mesh_rung_child():
+    """The ``mesh`` rung (BENCH_MESH=1): GSPMD-vs-shard_map training on a
+    FORCED 8-logical-device host mesh (docs/DISTRIBUTED.md).
+
+    Two shapes — the 200k x 28 deep-tree shape and a feature-wide
+    2k-column shape (the histogram-pool-bound regime the sharding
+    planner exists for) — each trained under the data / feature / auto
+    (planner) GSPMD shardings plus the forced shard_map A/B partner,
+    with trees/s AND the compiled-HLO collective census (op counts +
+    bytes, ``GBDT.grow_hlo_census``) embedded per configuration.  Always
+    a host-mesh CPU rung by construction: the 8 logical devices stand in
+    for chips, so the numbers A/B the FORMULATIONS (who inserts the
+    collectives, what payloads move), not chip throughput — deciding the
+    on-chip default still needs a tunnel window
+    (``scripts/decide_flips.py`` renders the pair as coverage)."""
+    import time
+
+    import jax
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import config_from_params
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.utils import log as _log
+
+    _log.set_verbosity(-1)
+    n_devices = len(jax.devices())
+    n_timed = int(os.environ.get("BENCH_MESH_TREES", 1))
+    # per-shape sharding sets: feature sharding only makes sense on the
+    # wide shape (its histogram pool is the planner's reason to exist),
+    # and on the VIRTUAL mesh all 8 devices share one host's cores — the
+    # feature sharding of a 28-column shape would just 8x the row scans
+    configs_narrow = [
+        ("gspmd_data", {"parallel_impl": "gspmd", "mesh_shape": "data"}),
+        ("gspmd_auto", {"parallel_impl": "gspmd", "mesh_shape": "auto"}),
+        ("shardmap_data", {"parallel_impl": "shardmap"}),
+    ]
+    configs_wide = [
+        ("gspmd_feature", {"parallel_impl": "gspmd",
+                           "mesh_shape": "feature"}),
+        ("gspmd_auto", {"parallel_impl": "gspmd", "mesh_shape": "auto"}),
+        ("shardmap_data", {"parallel_impl": "shardmap"}),
+    ]
+    shapes = [
+        (int(os.environ.get("BENCH_MESH_ROWS", 200_000)),
+         int(os.environ.get("BENCH_MESH_FEATURES", 28)),
+         int(os.environ.get("BENCH_MESH_LEAVES", 63)), configs_narrow),
+        (int(os.environ.get("BENCH_MESH_WIDE_ROWS", 10_000)),
+         int(os.environ.get("BENCH_MESH_WIDE_FEATURES", 2000)),
+         int(os.environ.get("BENCH_MESH_WIDE_LEAVES", 15)), configs_wide),
+    ]
+    out_shapes = {}
+    headline = None
+    for rows, feats, leaves, configs in shapes:
+        key = f"{rows // 1000}kx{feats}"
+        params = {
+            "objective": "binary", "num_leaves": leaves,
+            "max_bin": int(os.environ.get("BENCH_MESH_MAX_BIN", 63)),
+            "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100,
+            "learning_rate": 0.1, "verbose": -1, "use_pallas": False,
+            "tree_learner": "data",
+        }
+        ds = None
+        rows_out = {}
+        for name, extra in configs:
+            p = dict(params, **extra)
+            cfg = config_from_params(p)
+            if ds is None:   # impl/mesh knobs never key construction
+                ds = _construct_cached(
+                    lambda: make_data(rows, feats, 0.0), cfg, rows, feats,
+                    0.0, p)
+            try:
+                booster = create_boosting(cfg, ds, create_objective(cfg))
+                booster.train_one_iter()          # warmup (compile)
+                jax.block_until_ready(booster.scores)
+                t0 = time.perf_counter()
+                for _ in range(n_timed):
+                    booster.train_one_iter()
+                jax.block_until_ready(booster.scores)
+                dt = (time.perf_counter() - t0) / n_timed
+                rec = {"trees_per_sec": round(1.0 / dt, 4),
+                       "impl": booster._parallel_impl,
+                       "collectives": booster.grow_hlo_census(
+                           label=f"{key}:{name}")}
+                if booster._gspmd_plan is not None:
+                    plan = booster._gspmd_plan
+                    rec["mesh"] = f"{plan.data}x{plan.feature}"
+                    rec["block_shard_bins"] = plan.block_shard_bins
+                rows_out[name] = rec
+            except Exception as e:   # one config never kills the rung
+                rows_out[name] = {"error": str(e)[:200]}
+        g = rows_out.get("gspmd_data") or rows_out.get("gspmd_feature") \
+            or {}
+        s = rows_out.get("shardmap_data", {})
+        if "trees_per_sec" in g and "trees_per_sec" in s:
+            rows_out["gspmd_vs_shardmap"] = round(
+                g["trees_per_sec"] / s["trees_per_sec"], 3)
+        out_shapes[key] = rows_out
+        if headline is None:
+            headline = g.get("trees_per_sec", 0.0)
+    result = {
+        "metric": f"mesh GSPMD-vs-shardmap data-parallel training "
+                  f"(cpu, forced {n_devices}-device host mesh)",
+        "value": headline or 0.0,
+        "unit": "trees/sec",
+        "vs_baseline": None,
+        "mesh": {"devices": n_devices, "timed_trees": n_timed,
+                 "shapes": out_shapes},
+    }
+    print(json.dumps(result))
+
+
 def child_main():
     """The measured workload.  Runs under BENCH_CHILD with the platform and
     histogram method fixed by the supervisor; prints the result JSON line."""
     platform_want = os.environ["BENCH_CHILD_PLATFORM"]      # 'tpu' | 'cpu'
     mode = os.environ.get("BENCH_CHILD_MODE", "segment")
+    if mode == "mesh":
+        # the mesh rung runs on a FORCED 8-logical-device host mesh —
+        # flags must land before the CPU client is created
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        _mesh_rung_child()
+        return
     #                      fused | pallas | einsum | segment (cpu)
     use_pallas = mode in ("fused", "pallas")
     if platform_want == "cpu":
@@ -694,6 +824,19 @@ def main():
         child_main()
         return
     timeout_s = int(os.environ.get("BENCH_STAGE_TIMEOUT", 3600))
+    if os.environ.get("BENCH_MESH") == "1":
+        # the mesh rung is its own single-child mode (forced host mesh,
+        # GSPMD-vs-shardmap A/B + compiled-HLO collective census) — the
+        # supervisor contract (one JSON line, errors survivable) holds
+        res = _run_child("cpu", "mesh", timeout_s)
+        if isinstance(res, dict):
+            print(json.dumps(res))
+        else:
+            print(json.dumps({
+                "metric": "mesh GSPMD-vs-shardmap data-parallel training",
+                "value": 0.0, "unit": "trees/sec", "vs_baseline": None,
+                "degraded": f"mesh rung failed: {res}"}))
+        return
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
     want = os.environ.get("BENCH_PLATFORM")  # force 'cpu' or 'tpu'
     ladder = [("tpu", "fused"), ("tpu", "pallas"), ("tpu", "einsum"),

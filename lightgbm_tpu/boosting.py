@@ -344,6 +344,7 @@ class GBDT:
         shapes and compare against the device capacity / ``hbm_budget``
         (obs/memory.preflight) before the grower compiles."""
         plan = self._pack_plan
+        gplan = self._gspmd_plan
         pred = obs_memory.predict_hbm(
             rows=self.num_data,
             features=int(np.shape(self.bins)[1]),
@@ -356,7 +357,13 @@ class GBDT:
             ordered_bins=self.grower_cfg.ordered_bins == "on",
             # 'auto' resolves ON everywhere since round 8 (grower.py)
             gather_words=self.grower_cfg.gather_words in ("on", "auto"),
-            bucket_min_log2=self.grower_cfg.bucket_min_log2)
+            bucket_min_log2=self.grower_cfg.bucket_min_log2,
+            # GSPMD: the pre-flight judges the PER-DEVICE peak the planner
+            # already sized the mesh for (docs/DISTRIBUTED.md)
+            data_shards=(gplan.data if gplan is not None else 1),
+            feature_shards=(gplan.feature if gplan is not None else 1),
+            block_shard_bins=(gplan.block_shard_bins
+                              if gplan is not None else False))
         self.memory_prediction = pred
         obs_memory.preflight(
             pred, hbm_budget=cfg.hbm_budget,
@@ -380,6 +387,8 @@ class GBDT:
         self._local_bins_cache = None
         self._pack_plan = None
         self._hist_bins = None
+        self._gspmd_mesh = None
+        self._gspmd_plan = None
         n_devices = len(jax.devices())
         use_dist = cfg.tree_learner != "serial" and (
             cfg.mesh_devices != 1 and n_devices > 1)
@@ -389,6 +398,38 @@ class GBDT:
                       "(per-process row partitions) or feature (full data "
                       "on every process) over >1 devices; a serial learner "
                       "would silently train per-partition models")
+        # distributed implementation (docs/DISTRIBUTED.md): gspmd writes
+        # the grow program over global NamedSharding arrays and the XLA
+        # partitioner inserts the collectives; shardmap is the historical
+        # explicit-psum choreography, kept as the forced A/B partner.
+        # Every downgrade from an explicit request is loud (the rung-
+        # honesty discipline: labels must name what runs).
+        impl = cfg.parallel_impl
+        if impl == "gspmd" and process_count() > 1:
+            log.warning("parallel_impl=gspmd is unavailable across "
+                        "processes for now; falling back to the shard_map "
+                        "learners (the multi-host axis keeps the proven "
+                        "path until on-chip numbers land)")
+            obs_counters.event(
+                "layout_downgrade", stage="boosting",
+                requested="parallel_impl=gspmd", resolved="shardmap",
+                reason="multi-process training")
+            impl = "shardmap"
+        if impl == "gspmd" and cfg.tree_learner == "voting":
+            log.warning("parallel_impl=gspmd is unavailable for "
+                        "tree_learner=voting (PV-tree vote compression IS "
+                        "call-site collective machinery); falling back to "
+                        "shard_map")
+            obs_counters.event(
+                "layout_downgrade", stage="boosting",
+                requested="parallel_impl=gspmd", resolved="shardmap",
+                reason="voting learner needs explicit vote collectives")
+            impl = "shardmap"
+        if impl == "auto":
+            impl = ("shardmap" if (process_count() > 1
+                                   or cfg.tree_learner == "voting")
+                    else "gspmd")
+        self._parallel_impl = impl if use_dist else "serial"
         # nibble-pack <=16-bin column pairs for the histogram path
         # (dense_nbits_bin.hpp analogue, data/packing.py).  Multi-process
         # global arrays and the feature-parallel column slicing keep the
@@ -463,6 +504,9 @@ class GBDT:
                 self._hist_bins = jnp.asarray(self._hist_bins)
             self.grow = jax.jit(make_grower(self.grower_cfg,
                                             pack_plan=self._pack_plan))
+            return
+        if self._parallel_impl == "gspmd":
+            self._setup_gspmd(cfg, train, n_devices)
             return
         from .parallel.learner import make_distributed_grower
         from .parallel.mesh import (make_2d_mesh, make_mesh, pad_features,
@@ -582,6 +626,133 @@ class GBDT:
                                             cfg.tree_learner, cfg.top_k,
                                             bundled=self.meta.col is not None,
                                             pack_plan=self._pack_plan)
+
+    def _setup_gspmd(self, cfg: Config, train: TrainingData,
+                     n_devices: int) -> None:
+        """GSPMD learner setup (docs/DISTRIBUTED.md): size the (batch,
+        feature) mesh — explicitly (``mesh_shape=DxF``) or through the
+        memory-driven planner (``mesh_shape=auto``:
+        ``parallel/mesh.plan_mesh`` evaluates the ``predict_hbm`` model
+        per candidate shape against the per-device capacity /
+        ``hbm_budget``, so a dataset that does not fit one chip's HBM
+        trains anyway and an impossible shape fails in milliseconds) —
+        place the global arrays, and build the NamedSharding grower.
+        XLA owns the data-plane collectives from here;
+        ``parallel/sync.py``'s host ladder keeps the control plane."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .parallel import gspmd as gspmd_mod
+        from .parallel import mesh as mesh_mod
+        # the partitioner owns the layout: Pallas kernels are manual-
+        # layout custom calls it cannot split, and the chunked-scan
+        # histograms make it all-gather the row shards — the flat
+        # scatter-add is the one partitionable formulation, so any other
+        # request is downgraded loudly BEFORE labels are read
+        if self.grower_cfg.hist_method != "segment":
+            log.warning("hist_method=%s is unavailable under "
+                        "parallel_impl=gspmd (the SPMD partitioner cannot "
+                        "split Pallas custom calls); using the flat "
+                        "segment-sum histogram",
+                        self.grower_cfg.hist_method)
+            obs_counters.event(
+                "layout_downgrade", stage="boosting",
+                requested=f"hist_method={self.grower_cfg.hist_method}",
+                resolved="segment", reason="gspmd partitioner owns the "
+                "histogram layout")
+            self.grower_cfg = self.grower_cfg._replace(
+                hist_method="segment")
+        nd = min(cfg.mesh_devices or n_devices, n_devices)
+        prefer = {"data": "data", "feature": "feature",
+                  "data_feature": "square"}.get(cfg.tree_learner, "data")
+        explicit = mesh_mod.parse_mesh_shape(cfg.mesh_shape, nd, prefer)
+        ncols = int(np.shape(self.bins)[1])
+        capacity = (int(cfg.hbm_budget) if cfg.hbm_budget > 0
+                    else obs_memory.device_capacity())
+        plan_kwargs = dict(
+            rows=self.num_data, features=ncols,
+            bins=self.grower_cfg.max_bin,
+            leaves=self.grower_cfg.num_leaves, num_class=self.num_class,
+            bin_bytes=int(np.asarray(self.bins).dtype.itemsize),
+            packed_cols=(self._pack_plan.num_storage_cols
+                         if self._pack_plan is not None else 0),
+            valid_rows=sum(vs.data.num_data for vs in self.valid_sets))
+        if explicit is not None:
+            d, f = explicit
+            from .obs.memory import predict_hbm
+            block = str(cfg.shard_axes).strip().lower().replace(" ", "") \
+                in ("batch,feature", "feature,batch")
+            pred = predict_hbm(data_shards=d, feature_shards=f,
+                               block_shard_bins=block, **plan_kwargs)
+            plan = mesh_mod.MeshPlan(
+                d, f, block, int(pred["peak_bytes"]), capacity,
+                dict(sorted({**pred["residents"],
+                             **pred["transients"]}.items(),
+                            key=lambda kv: -kv[1])[:4]),
+                f"explicit mesh_shape={cfg.mesh_shape}")
+        else:
+            # MeshPlanError propagates: the structured pre-flight error
+            # (nothing fits) must surface before anything compiles
+            plan = mesh_mod.plan_mesh(nd, capacity=capacity,
+                                      prefer=prefer, **plan_kwargs)
+        sa = str(cfg.shard_axes).strip().lower().replace(" ", "")
+        if sa == "batch":
+            plan = plan._replace(block_shard_bins=False)
+        elif sa in ("batch,feature", "feature,batch"):
+            plan = plan._replace(block_shard_bins=True)
+        obs_counters.event(
+            "mesh_plan", data=plan.data, feature=plan.feature,
+            block_shard_bins=plan.block_shard_bins,
+            per_device_bytes=plan.per_device_bytes,
+            capacity_bytes=plan.capacity, reason=plan.reason)
+        obs_counters.gauge("mesh_feature_shards", plan.feature)
+        mesh = mesh_mod.make_named_mesh(plan.data, plan.feature)
+        n = self.num_data
+        self._row_pad = mesh_mod.pad_rows(n, plan.data)
+        binned = np.asarray(self.bins)
+        if self._row_pad:
+            binned = np.pad(binned, ((0, self._row_pad), (0, 0)))
+        bins_spec = P(mesh_mod.BATCH_AXIS,
+                      mesh_mod.FEATURE_AXIS if plan.block_shard_bins
+                      else None)
+        self.bins = jax.device_put(binned, NamedSharding(mesh, bins_spec))
+        if self._hist_bins is not None:
+            hb = np.asarray(self._hist_bins)
+            if self._row_pad:
+                hb = np.pad(hb, ((0, self._row_pad), (0, 0)))
+            self._hist_bins = jax.device_put(
+                hb, NamedSharding(mesh, P(mesh_mod.BATCH_AXIS, None)))
+        self._gspmd_mesh = mesh
+        self._gspmd_plan = plan
+        self._gspmd_row_sharding = NamedSharding(
+            mesh, P(mesh_mod.BATCH_AXIS))
+        log.info("Using GSPMD %s learner over a %dx%d (batch, feature) "
+                 "mesh (%s)", cfg.tree_learner, plan.data, plan.feature,
+                 plan.reason)
+        self.grow = gspmd_mod.make_gspmd_grower(
+            self.grower_cfg, mesh, bundled=self.meta.col is not None,
+            pack_plan=self._pack_plan)
+
+    def grow_hlo_census(self, label: str = "grow") -> Dict[str, Dict[str, int]]:
+        """Compiled-HLO collective census of the CURRENT grower
+        executable (``obs/collectives.hlo_census``): lowers ``self.grow``
+        at the exact training shapes/shardings — with the jit cache and
+        the persistent compilation cache this reuses the training's own
+        executable — and returns ``{op: {count, bytes, max_bytes}}``.
+        This is the honest accounting under GSPMD, where the compiler
+        (not a call site) decides which collectives run; bench.py's mesh
+        rung and tests/test_gspmd.py's audit both read it."""
+        from .obs.collectives import hlo_census
+        zero = self._dist_row_vec(jnp.zeros((self.num_data,), jnp.float32))
+        hist_arg = ((self._hist_bins,)
+                    if self._pack_plan is not None else ())
+        feat_mask = np.ones(len(self._feat_valid_base), dtype=bool)
+        if self._feat_pad:
+            feat_mask = np.concatenate(
+                [feat_mask, np.zeros(self._feat_pad, dtype=bool)])
+        if not self._multiproc:
+            feat_mask = jnp.asarray(feat_mask)
+        compiled = self.grow.lower(self.bins, *hist_arg, zero, zero, zero,
+                                   self.meta, feat_mask).compile()
+        return hlo_census(compiled, label=label)
 
     def _make_metrics(self, data: TrainingData) -> List[Metric]:
         out = []
@@ -899,7 +1070,13 @@ class GBDT:
         each process holds its own partition (device-to-device: the local
         slices are placed on their local devices, never via host)."""
         if not self._multiproc:
-            return jnp.pad(x, (0, self._row_pad)) if self._row_pad else x
+            x = jnp.pad(x, (0, self._row_pad)) if self._row_pad else x
+            if self._gspmd_mesh is not None:
+                # commit to the mesh's batch sharding so the grower's
+                # input shardings stay stable across iterations (no
+                # reshard-driven recompiles)
+                return jax.device_put(x, self._gspmd_row_sharding)
+            return x
         xl = jnp.pad(jnp.asarray(x, jnp.float32), (0, self._row_pad)) \
             if self._row_pad else jnp.asarray(x, jnp.float32)
         imap = self._row_sharding.addressable_devices_indices_map(
@@ -919,6 +1096,11 @@ class GBDT:
     def _local_rows(self, row_leaf) -> jnp.ndarray:
         """The grower's row-sharded output -> this process's local rows."""
         if not self._multiproc:
+            if self._gspmd_mesh is not None:
+                # fully addressable single-process global array: read it
+                # out once per tree (the multiproc path's precedent) so
+                # the score update consumes an unsharded map
+                return jnp.asarray(np.asarray(row_leaf)[:self.num_data])
             return row_leaf[:self.num_data] if self._row_pad else row_leaf
         if self._multiproc_replicated:   # fully addressable: read directly
             return jnp.asarray(np.asarray(row_leaf)[:self.num_data])

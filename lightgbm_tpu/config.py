@@ -326,6 +326,30 @@ class Config:
     machine_list_file: str = ""
     # TPU additions: how many mesh devices to use per axis; 0 = all available
     mesh_devices: int = 0
+    parallel_impl: str = "auto"    # distributed learner implementation
+                                   # (docs/DISTRIBUTED.md): auto | gspmd |
+                                   # shardmap.  ``gspmd`` writes the grow
+                                   # program over global arrays with
+                                   # NamedSharding annotations and lets the
+                                   # XLA partitioner insert the collectives
+                                   # (the histogram reduce-scatter included);
+                                   # ``shardmap`` is the historical explicit
+                                   # psum/all_gather choreography, kept as
+                                   # the forced A/B partner.  ``auto``
+                                   # resolves gspmd single-process and
+                                   # shardmap across machines / for voting
+    mesh_shape: str = "auto"       # GSPMD (batch, feature) mesh extents:
+                                   # auto (the memory-driven planner,
+                                   # parallel/mesh.plan_mesh, sizes the mesh
+                                   # from predicted per-device HBM) | data
+                                   # (all devices on the batch axis) |
+                                   # feature | DxF (e.g. 2x4)
+    shard_axes: str = "auto"       # which mesh axes shard the BINNED
+                                   # matrix under gspmd: auto (planner:
+                                   # replicate over feature unless memory
+                                   # pressure forces block sharding) |
+                                   # batch | batch,feature (row x column
+                                   # block sharding)
     collective_timeout: float = 120.0  # seconds one host-object collective
                                        # attempt may block before it is
                                        # failed and retried (parallel/sync.py)
@@ -509,6 +533,22 @@ def check_param_conflicts(cfg: Config) -> None:
         log.warning("tree_learner=serial forces num_machines=1 "
                     "(config.cpp:222-225 semantics)")
         cfg.num_machines = 1
+    if cfg.parallel_impl not in ("auto", "gspmd", "shardmap"):
+        log.fatal("parallel_impl must be auto, gspmd, or shardmap; got %r",
+                  cfg.parallel_impl)
+    # mesh_shape syntax is validated here (the real device count is only
+    # known at learner setup, where extents are checked against it)
+    ms = str(cfg.mesh_shape or "auto").strip().lower()
+    if ms not in ("auto", "data", "feature"):
+        parts = ms.replace("*", "x").split("x")
+        if len(parts) != 2 or not all(p.strip().isdigit() for p in parts) \
+                or any(int(p) < 1 for p in parts):
+            log.fatal("mesh_shape must be auto, data, feature, or DxF "
+                      "(e.g. 2x4); got %r", cfg.mesh_shape)
+    sa = str(cfg.shard_axes or "auto").strip().lower().replace(" ", "")
+    if sa not in ("auto", "batch", "batch,feature", "feature,batch"):
+        log.fatal("shard_axes must be auto, batch, or batch,feature; "
+                  "got %r", cfg.shard_axes)
     # the 2-D hybrid shards data x feature over ONE process's mesh; fail at
     # parse time like the other conflicts instead of a late runtime fatal
     if cfg.tree_learner == "data_feature" and cfg.num_machines > 1:

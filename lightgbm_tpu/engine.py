@@ -371,6 +371,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     # a gauge is best-effort, but anything beyond a size
                     # that won't coerce to int is a real bug — let it raise
                     log.debug("grower_jit_entries gauge unavailable: %s", e)
+            # GSPMD trainings: record the compiled-HLO collective census
+            # (compiler-inserted collectives never hit a call-site
+            # counter) so the trace's final snapshot carries the real
+            # communication story (docs/DISTRIBUTED.md).  The lowering
+            # re-hits the persistent compilation cache, so this is a
+            # read, not a second compile, on any warm run.
+            if getattr(booster.inner, "_gspmd_mesh", None) is not None:
+                try:
+                    booster.inner.grow_hlo_census()
+                except Exception as e:   # telemetry is best-effort
+                    log.debug("grow HLO census unavailable: %s", e)
             # flush the memory summary (peak gauge + top residents event)
             # BEFORE the trace writes its final counter snapshot, so the
             # trace file carries the whole memory story

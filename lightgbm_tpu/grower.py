@@ -370,6 +370,69 @@ def _set(arr, idx, value):
     return arr.at[idx].set(value)
 
 
+def route_goes_left(binf, meta: FeatureMeta, feat, thr, dleft,
+                    has_categorical: bool = False, is_cat_l=None,
+                    cat_row=None, max_bin: int = 0):
+    """Left/right routing decision for rows with raw bin values ``binf``
+    on a split (feature ``feat``, threshold ``thr``) — tree.h:257-313.
+
+    ONE implementation shared by the windowed partition branches below and
+    the GSPMD grower's whole-column routing (``parallel/gspmd.py``): the
+    two paths must take bit-identical decisions, so the primitive sequence
+    lives here once.  ``binf`` is the PHYSICAL bin column (bundle decode
+    happens inside when the meta carries EFB maps)."""
+    if meta.col is not None:  # EFB: physical slot -> logical bin
+        binf = decode_bundle_bin(binf, feat, meta)
+    mt_f = meta.missing_type[feat]
+    nb_f = meta.num_bin[feat]
+    db_f = meta.default_bin[feat]
+    is_missing = (((mt_f == MISSING_NAN) & (binf == nb_f - 1))
+                  | ((mt_f == MISSING_ZERO) & (binf == db_f)))
+    goes_left = jnp.where(is_missing, dleft, binf <= thr)
+    if has_categorical:
+        cat_go_left = cat_row[jnp.clip(binf, 0, max_bin - 1)]
+        goes_left = jnp.where(is_cat_l, cat_go_left, goes_left)
+    return goes_left
+
+
+def pool_rows(res: SplitResult, axis: int):
+    """SplitResult fields -> packed split-pool rows (f32, i32) — the
+    round-8 frontier packing layout (``_LoopState.sf32``/``si32``)."""
+    f32 = jnp.stack([res.left_sum_g, res.left_sum_h, res.left_count,
+                     res.right_sum_g, res.right_sum_h,
+                     res.right_count, res.left_output,
+                     res.right_output], axis=axis)
+    i32 = jnp.stack([res.feature, res.threshold,
+                     res.default_left.astype(jnp.int32)], axis=axis)
+    return f32, i32
+
+
+def unpack_tree(num_leaves, tni, tnf, tlf, tli, tcat, tcatb,
+                cfg: "GrowerConfig") -> TreeArrays:
+    """Packed tree carriers -> the public :class:`TreeArrays` (one set of
+    column slices, outside any loop); shared by every grower flavor."""
+    L = cfg.num_leaves
+    return TreeArrays(
+        num_leaves=num_leaves,
+        split_feature=tni[:, 0],
+        threshold_bin=tni[:, 1],
+        default_left=tni[:, 2].astype(bool),
+        left_child=tni[:, 3],
+        right_child=tni[:, 4],
+        split_gain=tnf[:, 0],
+        internal_value=tnf[:, 1],
+        internal_count=tnf[:, 2],
+        leaf_value=tlf[:, 0],
+        leaf_count=tlf[:, 1],
+        leaf_parent=tli[:, 0],
+        leaf_depth=tli[:, 1],
+        is_cat=(tcat if cfg.has_categorical
+                else jnp.zeros((L - 1,), bool)),
+        cat_bins=(tcatb if cfg.has_categorical
+                  else jnp.zeros((L - 1, cfg.max_bin), bool)),
+    )
+
+
 def _depth_gate(res: SplitResult, leaf_depth, max_depth) -> SplitResult:
     """A leaf at depth d (root = 0) may be split iff d < max_depth
     (serial_tree_learner.cpp:326+ BeforeFindBestSplit guard)."""
@@ -723,17 +786,12 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                     # overflow int32, unlike a flattened N*F index
                     binf = bins.at[jnp.minimum(idx, n - 1), col_idx].get(
                         mode="promise_in_bounds").astype(jnp.int32)
-                if meta.col is not None:  # EFB: physical slot -> logical bin
-                    binf = decode_bundle_bin(binf, feat, meta)
-                mt_f = meta.missing_type[feat]
-                nb_f = meta.num_bin[feat]
-                db_f = meta.default_bin[feat]
-                is_missing = (((mt_f == MISSING_NAN) & (binf == nb_f - 1))
-                              | ((mt_f == MISSING_ZERO) & (binf == db_f)))
-                goes_left = jnp.where(is_missing, dleft, binf <= thr)
-                if cfg.has_categorical:
-                    cat_go_left = cat_row[jnp.clip(binf, 0, cfg.max_bin - 1)]
-                    goes_left = jnp.where(is_cat_l, cat_go_left, goes_left)
+                goes_left = route_goes_left(
+                    binf, meta, feat, thr, dleft,
+                    has_categorical=cfg.has_categorical,
+                    is_cat_l=is_cat_l if cfg.has_categorical else None,
+                    cat_row=cat_row if cfg.has_categorical else None,
+                    max_bin=cfg.max_bin)
                 goes_left = goes_left & valid
                 use_sort = cfg.partition_impl == "sort"
                 # the Pallas compaction kernel needs 512-row blocks, f32-
@@ -914,16 +972,6 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
         hist_store0 = hist_store0.at[0].set(hist_root)
         feat_ok_store0 = jnp.zeros((L, num_logical), bool).at[0].set(
             root_feat_ok)
-
-        def pool_rows(res: SplitResult, axis: int):
-            """SplitResult fields -> packed pool rows (f32, i32)."""
-            f32 = jnp.stack([res.left_sum_g, res.left_sum_h, res.left_count,
-                             res.right_sum_g, res.right_sum_h,
-                             res.right_count, res.left_output,
-                             res.right_output], axis=axis)
-            i32 = jnp.stack([res.feature, res.threshold,
-                             res.default_left.astype(jnp.int32)], axis=axis)
-            return f32, i32
 
         root_f32, root_i32 = pool_rows(res_root, 0)
         sgain0 = jnp.full((L,), -jnp.inf, res_root.gain.dtype).at[0].set(
@@ -1107,25 +1155,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
         state = lax.while_loop(cond, body, state)
         # unpack the packed carriers into the public TreeArrays ONCE per
         # tree (a handful of column slices outside the loop)
-        tree = TreeArrays(
-            num_leaves=state.step + 1,
-            split_feature=state.tni[:, 0],
-            threshold_bin=state.tni[:, 1],
-            default_left=state.tni[:, 2].astype(bool),
-            left_child=state.tni[:, 3],
-            right_child=state.tni[:, 4],
-            split_gain=state.tnf[:, 0],
-            internal_value=state.tnf[:, 1],
-            internal_count=state.tnf[:, 2],
-            leaf_value=state.tlf[:, 0],
-            leaf_count=state.tlf[:, 1],
-            leaf_parent=state.tli[:, 0],
-            leaf_depth=state.tli[:, 1],
-            is_cat=(state.tcat if cfg.has_categorical
-                    else jnp.zeros((L - 1,), bool)),
-            cat_bins=(state.tcatb if cfg.has_categorical
-                      else jnp.zeros((L - 1, cfg.max_bin), bool)),
-        )
+        tree = unpack_tree(state.step + 1, state.tni, state.tnf, state.tlf,
+                           state.tli, state.tcat, state.tcatb, cfg)
         row_leaf = _row_leaf_from_intervals(state.order, state.lsc[:, 0],
                                             state.lsc[:, 1], n)
         return tree, row_leaf

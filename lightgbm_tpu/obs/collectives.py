@@ -1,8 +1,8 @@
 """Collective-traffic accounting helpers.
 
-Two complementary mechanisms, both trace-time (collective SHAPES are
-backend-independent — the mesh is the unit of sharding, not the wire — so
-byte counts measured while tracing hold for any same-shard-count slice):
+Three complementary mechanisms (collective SHAPES are backend-independent
+— the mesh is the unit of sharding, not the wire — so byte counts
+measured at trace/compile time hold for any same-shard-count slice):
 
 * :func:`intercept` — monkeypatch ``lax.psum``/``pmax``/``pmin``/
   ``all_gather`` for a block and collect one record per traced collective
@@ -15,6 +15,13 @@ byte counts measured while tracing hold for any same-shard-count slice):
   issue; feeds the ``collective_calls`` / ``collective_bytes`` counters of
   :mod:`lightgbm_tpu.obs.counters` without any monkeypatching, so every
   distributed training run carries its collective budget in telemetry.
+* :func:`hlo_census` — the GSPMD-era accounting
+  (``parallel/gspmd.py``, docs/DISTRIBUTED.md): with ``NamedSharding``
+  the COMPILER inserts the collectives, so call-site counters undercount
+  by construction — the census reads them back out of the compiled
+  executable (``utils/jaxpr_audit.hlo_collective_census``) and records
+  them as ``hlo_collective_*`` counters + one ``hlo_collectives`` event,
+  keeping bench telemetry honest when no call site ever ran.
 """
 from __future__ import annotations
 
@@ -68,6 +75,30 @@ def note_collective(op: str, value: Any, axis: Any, site: str) -> None:
     nb = tree_nbytes(value)
     counters.inc("collective_calls", op=op, site=site)
     counters.inc("collective_bytes", value=nb, op=op, site=site)
+
+
+def hlo_census(compiled_or_text, label: str = "grow") -> Dict[str, Dict[str, int]]:
+    """Compiled-HLO collective census, recorded into the counter registry.
+
+    Returns ``{op: {"count", "bytes", "max_bytes"}}`` (see
+    ``utils/jaxpr_audit.hlo_collective_census``) and records each op as
+    ``hlo_collective_calls`` / ``hlo_collective_bytes`` counters tagged
+    ``op=<op>,label=<label>`` plus one structured ``hlo_collectives``
+    event, so reports and bench JSONs carry the compiler-inserted
+    communication next to the call-site counters."""
+    from ..utils.jaxpr_audit import hlo_collective_census
+    from .counters import counters
+    census = hlo_collective_census(compiled_or_text)
+    for op, rec in census.items():
+        counters.inc("hlo_collective_calls", value=rec["count"], op=op,
+                     label=label)
+        counters.inc("hlo_collective_bytes", value=rec["bytes"], op=op,
+                     label=label)
+    counters.event(
+        "hlo_collectives", label=label,
+        **{op.replace("-", "_"): f"{rec['count']}x/{rec['bytes']}B"
+           for op, rec in census.items()})
+    return census
 
 
 @contextlib.contextmanager

@@ -317,7 +317,9 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
                 bucket_min_log2: int = 6, serving_trees: int = 0,
                 serving_nodes: int = 0, serving_cols: int = 0,
                 serving_bins: int = 0,
-                serving_buckets: Sequence[int] = ()) -> Dict[str, Any]:
+                serving_buckets: Sequence[int] = (),
+                data_shards: int = 1, feature_shards: int = 1,
+                block_shard_bins: bool = False) -> Dict[str, Any]:
     """Analytic device-memory model of one training (the codified
     ``docs/MEMORY.md`` audit; that doc's table is generated from this
     function by ``scripts/gen_memory_doc.py``).
@@ -330,46 +332,84 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
     ``peak_bytes`` = residents + transients; ``resident_bytes`` is the
     number the census-based CPU validation compares against (tolerance
     :data:`RESIDENT_TOLERANCE`).
+
+    ``data_shards``/``feature_shards`` turn the model PER-DEVICE for a
+    GSPMD ``(batch, feature)`` mesh (docs/DISTRIBUTED.md): row-linear
+    terms divide by ``data_shards``, the histogram pool by
+    ``feature_shards``, and the binned matrix additionally by
+    ``feature_shards`` when ``block_shard_bins`` (``shard_axes``
+    block-shards the data itself).  This is what makes the function the
+    sharding PLANNER's cost model (``parallel/mesh.plan_mesh``): the
+    planner evaluates it per candidate mesh shape and picks one whose
+    per-device peak fits the chip.  Defaults (1, 1) reproduce the
+    single-device model unchanged.
     """
     rows = int(rows)
     features = int(features)
+    d = max(int(data_shards), 1)
+    fs = max(int(feature_shards), 1)
+    rows_d = -(-rows // d)                  # rows per data shard (ceil)
     if bin_bytes is None:
         bin_bytes = 1 if bins < 256 else 2
-    maxbuf = _pow2_at_least(rows, 1 << bucket_min_log2)
+    maxbuf = _pow2_at_least(rows_d, 1 << bucket_min_log2)
     residents = {
-        # the binned matrix [N, C] (+ the nibble-packed histogram copy)
-        "binned": rows * features * bin_bytes,
-        "packed": rows * int(packed_cols),
+        # the binned matrix [N, C] (+ the nibble-packed histogram copy):
+        # row-sharded over ``batch``; over ``feature`` too when the
+        # planner block-shards it
+        "binned": rows_d * -(-features // (fs if block_shard_bins else 1))
+        * bin_bytes,
+        "packed": rows_d * int(packed_cols),
         # train scores live twice per class: the current array + the
         # iteration-start rollback stash (boosting.train_one_iter)
-        "scores": 2 * num_class * rows * 4,
+        "scores": 2 * num_class * rows_d * 4,
         # per-iteration gradient/hessian pair, alive through the tree phase
-        "grad_hess": 2 * num_class * rows * 4,
+        "grad_hess": 2 * num_class * rows_d * 4,
         # the objective's label + ~2 derived per-row device vectors
         # (binary's sign/weight; a rough but measured-against constant)
-        "objective": 3 * rows * 4,
+        "objective": 3 * rows_d * 4,
         # bagging weight + count vectors
-        "bagging": 2 * rows * 4,
+        "bagging": 2 * rows_d * 4,
         # each valid set: binned matrix + per-class scores
-        "valid": int(valid_rows) * (features * bin_bytes + num_class * 4),
+        "valid": -(-int(valid_rows) // d) * (features * bin_bytes
+                                             + num_class * 4),
     }
     words_bytes = (-(-features * bin_bytes // 4) + 3) * 4  # [W+3] u32 panel
     row_bytes = features * bin_bytes + 12                  # bins + g,h,c
-    transients = {
-        # sentinel-padded copy of the histogram inputs (hbins_pad + the
-        # three weight vectors; the word/panel layout on TPU)
-        "staging": (rows + 1) * (words_bytes if gather_words else row_bytes),
-        # order [N + maxbuf] i32 + the final row->leaf map [N] i32
-        "order_partition": (rows + maxbuf) * 4 + rows * 4,
-        # the per-leaf histogram pool [L, F, B, 3] f32
-        "hist_store": leaves * features * bins * 3 * 4,
-        # the pow2 gather buffer for the largest bucket
-        "gather_buffer": maxbuf * (words_bytes if gather_words
-                                   else row_bytes),
-        # leaf-ordered copies ride the carry when ordered_bins=on
-        "ordered_copies": ((rows + maxbuf) * row_bytes
-                           if ordered_bins else 0),
-    }
+    # the per-leaf histogram pool [L, F, B, 3] f32 — sharded over the
+    # ``feature`` mesh axis (the planner's main lever: this is the
+    # component that outgrows a chip first at Epsilon-wide shapes)
+    pool_bytes = leaves * -(-features // fs) * bins * 3 * 4
+    if d > 1 or fs > 1:
+        # GSPMD grower layout (parallel/gspmd.py): no gather buckets, no
+        # sentinel staging, no ``order`` permutation — the partition is
+        # the row_leaf map and the per-split histogram is one flat
+        # masked scatter-add whose workspace (segment indices i32 + the
+        # broadcast (g, h, c) value rows) covers this device's row shard
+        # x its histogram columns (all columns when the binned matrix is
+        # replicated along ``feature``, its own slice when block-sharded)
+        fcols = -(-features // (fs if block_shard_bins else 1))
+        transients = {
+            "hist_scatter": rows_d * fcols * 16,
+            # row_leaf carry + routing column + child mask
+            "row_leaf": 3 * rows_d * 4,
+            "hist_store": pool_bytes,
+        }
+    else:
+        transients = {
+            # sentinel-padded copy of the histogram inputs (hbins_pad +
+            # the three weight vectors; the word/panel layout on TPU)
+            "staging": (rows_d + 1) * (words_bytes if gather_words
+                                       else row_bytes),
+            # order [N + maxbuf] i32 + the final row->leaf map [N] i32
+            "order_partition": (rows_d + maxbuf) * 4 + rows_d * 4,
+            "hist_store": pool_bytes,
+            # the pow2 gather buffer for the largest bucket
+            "gather_buffer": maxbuf * (words_bytes if gather_words
+                                       else row_bytes),
+            # leaf-ordered copies ride the carry when ordered_bins=on
+            "ordered_copies": ((rows_d + maxbuf) * row_bytes
+                               if ordered_bins else 0),
+        }
     if serving_trees > 0:
         # the serving engine's term (docs/SERVING.md): resident SoA node
         # arrays [Tp, P] (feat/thr/left/right i32 + miss/cat_ref i32 +
@@ -392,7 +432,9 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
                    "bin_bytes": bin_bytes, "packed_cols": int(packed_cols),
                    "valid_rows": int(valid_rows),
                    "ordered_bins": bool(ordered_bins),
-                   "gather_words": bool(gather_words)},
+                   "gather_words": bool(gather_words),
+                   "data_shards": d, "feature_shards": fs,
+                   "block_shard_bins": bool(block_shard_bins)},
         "residents": residents,
         "transients": transients,
         "resident_bytes": resident_bytes,

@@ -331,6 +331,19 @@ def render(path) -> str:
             ["op", "site", "bytes"],
             [[_split_tags(k).get("op", "?"), _split_tags(k).get("site", "-"),
               int(v)] for k, v in sorted(coll.items())])
+    hlo_calls = counters.get("hlo_collective_calls", {})
+    if hlo_calls:
+        # compiler-inserted collectives (GSPMD): call-site counters can't
+        # see these — the census reads the compiled executable
+        # (obs/collectives.hlo_census, docs/DISTRIBUTED.md)
+        hlo_bytes = counters.get("hlo_collective_bytes", {})
+        lines += ["", "## Compiled-HLO collective census "
+                  "(compiler-inserted)", ""]
+        lines += _md_table(
+            ["op", "executable", "ops", "bytes"],
+            [[_split_tags(k).get("op", "?"),
+              _split_tags(k).get("label", "-"), int(v),
+              int(hlo_bytes.get(k, 0))] for k, v in sorted(hlo_calls.items())])
     lines += _serving_lines(events, counters, snap.get("gauges", {}))
     lines += _memory_lines(snap)
     events_list = snap.get("events", [])

@@ -1,4 +1,6 @@
 from . import mesh  # noqa: F401
+from .gspmd import make_gspmd_grower  # noqa: F401
 from .learner import (DataParallelStrategy, FeatureParallelStrategy,  # noqa: F401
                       VotingStrategy, make_distributed_grower)
-from .mesh import make_mesh  # noqa: F401
+from .mesh import (MeshPlan, MeshPlanError, make_mesh,  # noqa: F401
+                   make_named_mesh, plan_mesh)

@@ -1,0 +1,302 @@
+"""GSPMD tree growing: NamedSharding over a named (batch, feature) mesh.
+
+The shard_map learners (``parallel/learner.py``) re-created the
+reference's hand-rolled network layer in XLA clothing: every psum /
+all_gather is still a CALL SITE someone chose.  This module inverts the
+contract — the grow program is written once over GLOBAL arrays, inputs
+and loop carries are annotated with :class:`jax.sharding.NamedSharding`
+over a named 2-D ``(batch, feature)`` mesh, and the XLA SPMD partitioner
+inserts (and overlaps) the collectives itself:
+
+* binned data, gradients and the row->leaf partition carry row-sharded
+  on ``batch`` (optionally block-sharded over ``feature`` too — the
+  "Block-distributed Gradient Boosted Trees" row x column layout);
+* the per-leaf histogram pool ``[L, F, B, 3]`` shards on ``feature`` —
+  the component that outgrows one chip's HBM first (docs/MEMORY.md), and
+  the reason ``mesh_shape=auto`` exists (``parallel/mesh.plan_mesh``);
+* the per-split histogram is a plain masked sum over rows; with the
+  output constrained to the feature sharding, the partitioner has each
+  device reduce only its own output slice and inserts the shard-sized
+  cross-``batch`` reduction — the reduce-scatter the reference
+  implemented by hand (``data_parallel_tree_learner.cpp:148-163``),
+  now owned by the compiler (pinned via the compiled-HLO census,
+  ``utils/jaxpr_audit.hlo_collective_census``).
+
+What changes against the windowed serial grower: the ``order``
+permutation (and its gather-bucket ``lax.switch``) cannot live under
+GSPMD — a data-dependent window slice of a sharded carrier would force
+the partitioner to materialize the global array.  The partition is
+instead the direct row->leaf map: routing a split is one elementwise
+update of ``row_leaf`` (collective-free — every row's bin is local), and
+the smaller child's histogram masks on ``row_leaf == child`` over all
+local rows.  Per-device split cost is O(rows/shard) instead of the
+serial path's O(window) — the trade the reference's data-parallel
+learner also makes (each worker scans its whole partition), bought back
+by sharding.  Routing decisions, split selection and leaf outputs reuse
+the serial grower's exact helpers (``route_goes_left`` / ``best_split``
+/ ``pool_rows`` / ``unpack_tree``), so trees are the SAME trees —
+byte-identical under order-insensitive (integer) weights, pinned across
+mesh shapes in tests/test_gspmd.py.
+
+``parallel/sync.py``'s hardened host-object ladder stays the
+control-plane (bin finding, checkpoint barriers, preemption agreement):
+GSPMD owns the data plane only.  The shard_map learners remain the
+forced A/B partner (``parallel_impl=shardmap``) until on-chip numbers
+land.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.packing import PACK_JOINT_BINS, unfold_packed_hist
+from ..grower import (FeatureMeta, GrowerConfig, _depth_gate,
+                      expand_bundle_hist, make_expand_maps, pool_rows,
+                      route_goes_left, unpack_tree)
+from ..obs import trace as obs_trace
+from ..obs.counters import counters as obs_counters
+from ..ops.histogram import subset_histogram_flat
+from ..ops.split import best_split, leaf_output, make_fused_ctx
+from .mesh import BATCH_AXIS, FEATURE_AXIS
+
+
+def make_gspmd_grower(cfg: GrowerConfig, mesh: Mesh,
+                      bundled: bool = False, pack_plan=None) -> Callable:
+    """Build the jitted GSPMD ``grow_tree`` over global arrays.
+
+    Same call signature as ``make_grower``'s product — ``fn(bins,
+    [hist_bins,] gw, hw, cw, meta, feat_valid) -> (TreeArrays,
+    row_leaf)`` — operating on arrays placed with
+    ``NamedSharding(mesh, ...)`` (uncommitted inputs are resharded by the
+    first call).  ``row_leaf`` comes back row-sharded on ``batch``.
+
+    The histogram method is always the flat scatter-add
+    (``subset_histogram_flat``): the Pallas kernels are manual-layout
+    custom calls the SPMD partitioner cannot split, and the scan-chunked
+    forms make it all-gather the row shards (module docstring) — the
+    caller (``boosting._setup_grower``) downgrades any other request
+    loudly before this builder runs.
+    """
+    L = cfg.num_leaves
+    hist_width = (max(PACK_JOINT_BINS, cfg.max_bin) if pack_plan is not None
+                  else cfg.max_bin)
+    shard_hist = int(mesh.shape[FEATURE_AXIS]) > 1
+
+    def cstr(x, spec):
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    def grow_impl(bins, hist_src, gw, hw, cw, meta: FeatureMeta,
+                  feat_valid):
+        n, f = bins.shape
+        dtype = gw.dtype
+        maps = (make_expand_maps(meta, cfg.max_bin)
+                if meta.col is not None else None)
+        scfg = cfg.split_config()
+        fctx = (make_fused_ctx(meta.num_bin, meta.missing_type,
+                               meta.default_bin, cfg.max_bin, scfg)
+                if scfg.split_find == "fused" else None)
+        num_logical = meta.num_bin.shape[0]
+        fh = (pack_plan.num_phys_cols if pack_plan is not None
+              else hist_src.shape[1])
+        tracer = obs_trace.get_tracer()
+
+        def find(hist, pg, ph, pc, feat_ok):
+            obs_counters.inc("split_find_dispatch", impl=cfg.split_find)
+            with tracer.span("split_find", traced=True,
+                             impl=cfg.split_find), \
+                    jax.named_scope("split_find"):
+                if maps is not None:
+                    hist = expand_bundle_hist(hist, pg, ph, pc, maps)
+                return best_split(hist, pg, ph, pc, meta.num_bin,
+                                  meta.missing_type, meta.default_bin,
+                                  feat_valid & feat_ok, scfg,
+                                  is_cat=meta.is_categorical,
+                                  with_feat_ok=True, fused_ctx=fctx)
+
+        def measure(g_, h_, c_, site):
+            """Masked whole-partition histogram: the sum over the row
+            axis IS the collective — with the feature-sharded output
+            constraint each device reduces only its own slice and XLA
+            inserts the shard-sized cross-batch reduction."""
+            hist = subset_histogram_flat(hist_src, g_, h_, c_, hist_width,
+                                         site=site)
+            if pack_plan is not None:
+                hist = unfold_packed_hist(hist, pack_plan, cfg.max_bin)
+            return cstr(hist, P(FEATURE_AXIS if shard_hist else None,
+                                None, None))
+
+        # ---- root -------------------------------------------------------
+        root_g = jnp.sum(gw)
+        root_h = jnp.sum(hw)
+        root_c = jnp.sum(cw)
+        feat_ok_all = jnp.ones((num_logical,), bool)
+        with tracer.span("histogram", site="root", traced=True), \
+                jax.named_scope("histogram"):
+            hist_root = measure(gw, hw, cw, site="root")
+        res_root, root_feat_ok = find(hist_root, root_g, root_h, root_c,
+                                      feat_ok_all)
+        res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
+
+        store_spec = P(None, FEATURE_AXIS if shard_hist else None,
+                       None, None)
+        hist_store0 = cstr(jnp.zeros((L, fh, cfg.max_bin, 3), dtype)
+                           .at[0].set(hist_root), store_spec)
+        feat_ok_store0 = jnp.zeros((L, num_logical), bool).at[0].set(
+            root_feat_ok)
+        root_f32, root_i32 = pool_rows(res_root, 0)
+        sgain0 = jnp.full((L,), -jnp.inf, res_root.gain.dtype).at[0].set(
+            res_root.gain)
+        sf32_0 = jnp.zeros((L, 8), dtype).at[0].set(root_f32)
+        si32_0 = jnp.zeros((L, 3), jnp.int32).at[0].set(root_i32)
+        if cfg.has_categorical:
+            scat0 = jnp.zeros((L,), bool).at[0].set(res_root.is_cat)
+            scatb0 = jnp.zeros((L, cfg.max_bin), bool).at[0].set(
+                res_root.cat_bins)
+            tcat0 = jnp.zeros((L - 1,), bool)
+            tcatb0 = jnp.zeros((L - 1, cfg.max_bin), bool)
+        else:
+            scat0 = jnp.zeros((0,), bool)
+            scatb0 = jnp.zeros((0, 0), bool)
+            tcat0 = jnp.zeros((0,), bool)
+            tcatb0 = jnp.zeros((0, 0), bool)
+        tnf0 = jnp.zeros((L - 1, 3), dtype)
+        tni0 = jnp.zeros((L - 1, 5), jnp.int32)
+        tlf0 = jnp.zeros((L, 2), dtype).at[0, 1].set(root_c)
+        tli0 = jnp.concatenate([jnp.full((L, 1), -1, jnp.int32),
+                                jnp.zeros((L, 1), jnp.int32)], axis=1)
+        row_leaf0 = cstr(jnp.zeros((n,), jnp.int32), P(BATCH_AXIS))
+
+        def cond(state):
+            step = state[0]
+            sgain = state[2]
+            return (step < L - 1) & (jnp.max(sgain) > 0.0)
+
+        def body(state):
+            (i, row_leaf, sgain, sf32, si32, scat, scatb, hist_store,
+             feat_ok, tnf, tni, tlf, tli, tcat, tcatb) = state
+            l = jnp.argmax(sgain).astype(jnp.int32)
+            new_leaf = i + 1
+            node = i
+            pair_lr = jnp.stack([l, new_leaf])
+
+            irow = lax.dynamic_index_in_dim(si32, l, axis=0, keepdims=False)
+            frow = lax.dynamic_index_in_dim(sf32, l, axis=0, keepdims=False)
+            feat, thr = irow[0], irow[1]
+            dleft = irow[2].astype(bool)
+
+            # --- routing: ONE elementwise pass over the row partition
+            #     (DataPartition::Split without the window machinery —
+            #     every row's bin is shard-local, so no collective) -------
+            col_idx = feat if meta.col is None else meta.col[feat]
+            binf = lax.dynamic_index_in_dim(
+                bins, col_idx, axis=1, keepdims=False).astype(jnp.int32)
+            cat_args = ((scat[l], scatb[l]) if cfg.has_categorical else ())
+            with tracer.span("partition", traced=True), \
+                    jax.named_scope("partition"):
+                goes_left = route_goes_left(
+                    binf, meta, feat, thr, dleft,
+                    has_categorical=cfg.has_categorical,
+                    is_cat_l=cat_args[0] if cfg.has_categorical else None,
+                    cat_row=cat_args[1] if cfg.has_categorical else None,
+                    max_bin=cfg.max_bin)
+                in_l = row_leaf == l
+                row_leaf = cstr(jnp.where(
+                    in_l, jnp.where(goes_left, l, new_leaf), row_leaf),
+                    P(BATCH_AXIS))
+
+            # --- record the node (same writes as the serial body) --------
+            prow = lax.dynamic_index_in_dim(tli, l, axis=0, keepdims=False)
+            parent_node = prow[0]
+            child_depth = prow[1] + 1
+            pn_safe = jnp.where(parent_node >= 0, parent_node, node)
+            side = jnp.where(tni[pn_safe, 3] == ~l, 3, 4)
+            tni = tni.at[pn_safe, side].set(node, mode="promise_in_bounds")
+            tni = tni.at[node].set(
+                jnp.stack([feat, thr, irow[2], ~l, ~new_leaf]),
+                mode="promise_in_bounds")
+            parent_g = frow[0] + frow[3]
+            parent_h = frow[1] + frow[4]
+            tnf = tnf.at[node].set(
+                jnp.stack([sgain[l],
+                           leaf_output(parent_g, parent_h,
+                                       cfg.lambda_l1, cfg.lambda_l2),
+                           tlf[l, 1]]),
+                mode="promise_in_bounds")
+            tlf = tlf.at[pair_lr].set(
+                jnp.stack([jnp.stack([frow[6], frow[2]]),
+                           jnp.stack([frow[7], frow[5]])]),
+                unique_indices=True, mode="promise_in_bounds")
+            tli = tli.at[pair_lr].set(
+                jnp.broadcast_to(jnp.stack([node, child_depth]), (2, 2)),
+                unique_indices=True, mode="promise_in_bounds")
+            if cfg.has_categorical:
+                tcat = tcat.at[node].set(cat_args[0],
+                                         mode="promise_in_bounds")
+                tcatb = tcatb.at[node].set(cat_args[1],
+                                           mode="promise_in_bounds")
+
+            # --- smaller-child histogram + parent subtraction ------------
+            small_left = frow[2] <= frow[5]
+            small_id = jnp.where(small_left, l, new_leaf)
+            mask = (row_leaf == small_id).astype(dtype)
+            with tracer.span("histogram", site="split", traced=True), \
+                    jax.named_scope("histogram"):
+                hist_small = measure(gw * mask, hw * mask, cw * mask,
+                                     site="split")
+            hist_parent = lax.dynamic_index_in_dim(hist_store, l, axis=0,
+                                                   keepdims=False)
+            hist_large = hist_parent - hist_small
+            hist2 = jnp.stack([hist_small, hist_large])
+            pair_sl = jnp.where(small_left, pair_lr, pair_lr[::-1])
+            hist_store = cstr(hist_store.at[pair_sl].set(
+                hist2, unique_indices=True, mode="promise_in_bounds"),
+                store_spec)
+
+            fok_parent = lax.dynamic_index_in_dim(feat_ok, l, axis=0,
+                                                  keepdims=False)
+            lr3 = jnp.stack([lax.slice(frow, (0,), (3,)),
+                             lax.slice(frow, (3,), (6,))])
+            sl3 = jnp.where(small_left, lr3, lr3[::-1])
+            res2, fok2 = jax.vmap(find, in_axes=(0, 0, 0, 0, None))(
+                hist2, sl3[:, 0], sl3[:, 1], sl3[:, 2], fok_parent)
+            res2 = _depth_gate(res2, child_depth, cfg.max_depth)
+            feat_ok = feat_ok.at[pair_sl].set(fok2 & fok_parent[None, :],
+                                              unique_indices=True)
+            rows_f32, rows_i32 = pool_rows(res2, 1)
+            sgain = sgain.at[pair_sl].set(
+                res2.gain, unique_indices=True, mode="promise_in_bounds")
+            sf32 = sf32.at[pair_sl].set(
+                rows_f32, unique_indices=True, mode="promise_in_bounds")
+            si32 = si32.at[pair_sl].set(
+                rows_i32, unique_indices=True, mode="promise_in_bounds")
+            if cfg.has_categorical:
+                scat = scat.at[pair_sl].set(
+                    res2.is_cat, unique_indices=True,
+                    mode="promise_in_bounds")
+                scatb = scatb.at[pair_sl].set(
+                    res2.cat_bins, unique_indices=True,
+                    mode="promise_in_bounds")
+            return (i + 1, row_leaf, sgain, sf32, si32, scat, scatb,
+                    hist_store, feat_ok, tnf, tni, tlf, tli, tcat, tcatb)
+
+        state = (jnp.asarray(0, jnp.int32), row_leaf0, sgain0, sf32_0,
+                 si32_0, scat0, scatb0, hist_store0, feat_ok_store0,
+                 tnf0, tni0, tlf0, tli0, tcat0, tcatb0)
+        state = lax.while_loop(cond, body, state)
+        (step, row_leaf, _, _, _, _, _, _, _,
+         tnf, tni, tlf, tli, tcat, tcatb) = state
+        tree = unpack_tree(step + 1, tni, tnf, tlf, tli, tcat, tcatb, cfg)
+        return tree, row_leaf
+
+    if pack_plan is None:
+        def grow_tree(bins, gw, hw, cw, meta, feat_valid):
+            return grow_impl(bins, bins, gw, hw, cw, meta, feat_valid)
+        return jax.jit(grow_tree)
+
+    def grow_tree_packed(bins, hist_bins, gw, hw, cw, meta, feat_valid):
+        return grow_impl(bins, hist_bins, gw, hw, cw, meta, feat_valid)
+    return jax.jit(grow_tree_packed)

@@ -21,6 +21,15 @@ Re-designs the reference's parallel tree learners
 
 All strategies plug into ``make_grower`` and are wrapped in ``shard_map`` by
 :func:`make_distributed_grower`.
+
+Since the GSPMD rewrite (``parallel/gspmd.py``, docs/DISTRIBUTED.md) this
+module is the FORCED A/B PARTNER (``parallel_impl=shardmap``), not the
+default: the NamedSharding path lets the XLA partitioner insert and
+overlap the same collectives this file issues by hand.  ``auto`` still
+resolves here for multi-process training and for the voting learner
+(PV-tree's vote compression is call-site collective machinery by nature)
+— and the explicit choreography below remains the reference against
+which the compiler-owned path is A/B'd until on-chip numbers land.
 """
 from __future__ import annotations
 

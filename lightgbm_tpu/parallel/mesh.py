@@ -10,7 +10,7 @@ rank → ``lax.axis_index``, Allreduce/ReduceScatter → ``lax.psum`` /
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence
 
 import jax
 import numpy as np
@@ -18,6 +18,12 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
+
+# GSPMD mesh axes (docs/DISTRIBUTED.md): rows shard over ``batch``, the
+# histogram pool over ``feature``.  The shard_map learners keep the
+# historical ``data`` spelling above; the named-sharding mesh follows the
+# (batch, feature) convention of the block-distributed formulation.
+BATCH_AXIS = "batch"
 
 
 def distributed_is_initialized() -> bool:
@@ -52,6 +58,148 @@ def make_2d_mesh(data: int, feature: int) -> Mesh:
     parallel/learner.py DataFeatureStrategy)."""
     devs = np.asarray(jax.devices()[:data * feature]).reshape(data, feature)
     return Mesh(devs, (DATA_AXIS, FEATURE_AXIS))
+
+
+def make_named_mesh(data: int, feature: int,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """``(batch, feature)`` named mesh for the GSPMD learners
+    (``parallel/gspmd.py``): rows shard over ``batch``, the histogram
+    pool over ``feature``.  Either extent may be 1 (pure data- or pure
+    feature-sharding); the product must not exceed the device count."""
+    devs = list(devices) if devices is not None else jax.devices()
+    need = data * feature
+    if need > len(devs):
+        raise MeshPlanError(
+            f"mesh shape {data}x{feature} needs {need} devices; "
+            f"{len(devs)} available")
+    return Mesh(np.asarray(devs[:need]).reshape(data, feature),
+                (BATCH_AXIS, FEATURE_AXIS))
+
+
+class MeshPlanError(RuntimeError):
+    """Structured pre-flight failure of the sharding planner: no mesh
+    shape over the available devices fits the predicted per-device peak
+    in the capacity budget (the message carries the best candidate's
+    component breakdown so the fix — fewer leaves/bins, more chips, a
+    bigger budget — is actionable without a debugger)."""
+
+
+class MeshPlan(NamedTuple):
+    """One planner decision (``plan_mesh``): mesh extents, whether the
+    binned matrix itself is block-sharded over ``feature`` (vs replicated
+    along that axis), and the evidence backing the choice."""
+    data: int                  # batch-axis extent
+    feature: int               # feature-axis extent
+    block_shard_bins: bool     # bins P(batch, feature) vs P(batch, None)
+    per_device_bytes: int      # predicted per-device peak at this shape
+    capacity: Optional[int]    # budget the plan was judged against
+    components: dict           # top per-device components {name: bytes}
+    reason: str                # human-readable decision trail
+
+
+def _mesh_factorizations(n: int):
+    """(data, feature) candidates over exactly ``n`` devices, data-major
+    first (pure data-parallel is the cheapest shape when it fits: routing
+    and split-find stay collective-free)."""
+    out = [(d, n // d) for d in range(n, 0, -1) if n % d == 0]
+    return out
+
+
+def plan_mesh(n_devices: int, rows: int, features: int, bins: int = 255,
+              leaves: int = 31, num_class: int = 1,
+              bin_bytes: Optional[int] = None, packed_cols: int = 0,
+              valid_rows: int = 0, capacity: Optional[int] = None,
+              prefer: str = "data") -> MeshPlan:
+    """The memory-driven sharding planner (``mesh_shape=auto``).
+
+    Evaluates ``obs/memory.predict_hbm`` per candidate ``(data,
+    feature)`` factorization of ``n_devices`` and returns the first shape
+    — in preference order — whose predicted per-device peak fits
+    ``capacity``.  Preference: pure data-parallel first (``prefer="data"``,
+    the shape with no cross-shard routing or split-find traffic), walking
+    toward feature-heavy shapes only under memory pressure;
+    ``prefer="feature"`` walks the other way (the feature-parallel
+    learner's contract), ``prefer="square"`` starts at the most balanced
+    factorization (the 2-D hybrid).  Replication is part of the decision:
+    a shape is first tried with the binned matrix replicated along
+    ``feature`` (cheap routing) and block-sharded over both axes only if
+    replication alone does not fit.  With no capacity signal (CPU hosts
+    report none) the preferred shape wins outright.
+
+    Raises :class:`MeshPlanError` when nothing fits — a structured
+    pre-flight error in milliseconds instead of an on-chip OOM minutes
+    into a capture window."""
+    from ..obs.memory import predict_hbm
+    n_devices = max(int(n_devices), 1)
+    cands = _mesh_factorizations(n_devices)
+    if prefer == "feature":
+        cands = cands[::-1]
+    elif prefer == "square":
+        cands.sort(key=lambda df: (abs(df[0] - df[1]), -df[0]))
+
+    def per_device(d, f, block):
+        p = predict_hbm(rows=rows, features=features, bins=bins,
+                        leaves=leaves, num_class=num_class,
+                        bin_bytes=bin_bytes, packed_cols=packed_cols,
+                        valid_rows=valid_rows, data_shards=d,
+                        feature_shards=f, block_shard_bins=block)
+        comps = dict(sorted({**p["residents"], **p["transients"]}.items(),
+                            key=lambda kv: -kv[1])[:4])
+        return int(p["peak_bytes"]), comps
+
+    best = None            # smallest-peak candidate, for the error message
+    for d, f in cands:
+        for block in (False, True) if f > 1 else (False,):
+            peak, comps = per_device(d, f, block)
+            if best is None or peak < best[3]:
+                best = (d, f, block, peak, comps)
+            if capacity is None or peak <= capacity:
+                why = (f"{d}x{f} mesh"
+                       + (", bins block-sharded" if block
+                          else (", bins replicated over feature"
+                                if f > 1 else ""))
+                       + (f": predicted per-device peak "
+                          f"{peak / 1e9:.2f} GB fits capacity "
+                          f"{capacity / 1e9:.2f} GB"
+                          if capacity is not None else
+                          ": no capacity signal, preferred shape"))
+                return MeshPlan(d, f, block, peak, capacity, comps, why)
+    d, f, block, peak, comps = best
+    detail = ", ".join(f"{k}={v / 1e9:.2f} GB" for k, v in comps.items())
+    raise MeshPlanError(
+        f"no mesh shape over {n_devices} device(s) fits: best candidate "
+        f"{d}x{f}{' (bins block-sharded)' if block else ''} still needs "
+        f"{peak / 1e9:.2f} GB per device vs capacity "
+        f"{(capacity or 0) / 1e9:.2f} GB (top components: {detail}) — "
+        f"shrink the shape (num_leaves/max_bin/rows), add devices, or "
+        f"raise hbm_budget")
+
+
+def parse_mesh_shape(spec: str, n_devices: int, prefer: str = "data"):
+    """``mesh_shape`` parameter -> (data, feature) extents or None for
+    ``auto`` (planner decides).  Accepts ``DxF`` (``2x4``), ``data``
+    (all devices on the batch axis) and ``feature`` (all on the feature
+    axis); rejects shapes the device count cannot serve."""
+    s = str(spec or "auto").strip().lower()
+    if s in ("", "auto"):
+        return None
+    if s == "data":
+        return (n_devices, 1)
+    if s == "feature":
+        return (1, n_devices)
+    m = s.replace("*", "x").split("x")
+    if len(m) == 2 and all(p.strip().isdigit() for p in m):
+        d, f = int(m[0]), int(m[1])
+        if d < 1 or f < 1:
+            raise ValueError(f"mesh_shape extents must be >= 1; got {spec!r}")
+        if d * f > n_devices:
+            raise ValueError(
+                f"mesh_shape {d}x{f} needs {d * f} devices; only "
+                f"{n_devices} available")
+        return (d, f)
+    raise ValueError(
+        f"mesh_shape must be 'auto', 'data', 'feature', or 'DxF' "
+        f"(e.g. 2x4); got {spec!r}")
 
 
 def _enable_cpu_collectives() -> None:

@@ -32,6 +32,15 @@ The coordinated-checkpoint protocol (:mod:`lightgbm_tpu.checkpoint`) rides
 barrier and the resume agreement — so a rank that dies mid-snapshot
 surfaces as a named ``CollectiveError`` after ``collective_timeout``
 seconds on its peers, never a silent fleet hang.
+
+Division of labor under GSPMD (``parallel/gspmd.py``,
+docs/DISTRIBUTED.md): the NamedSharding learners hand the DATA-plane
+collectives (histogram reductions, split agreement) to the XLA
+partitioner, but this module stays load-bearing as the CONTROL plane —
+bin finding, checkpoint barriers, resume agreement and preemption
+coordination are host-object exchanges that must survive peers dying
+mid-call, which is exactly what the ladder above provides and a compiled
+collective cannot.
 """
 from __future__ import annotations
 

@@ -1,4 +1,4 @@
-"""Structural audit of the jitted grow loop's body jaxpr.
+"""Structural audits of the jitted grow loop: body jaxpr + compiled HLO.
 
 The grow loop's per-split cost must scale with the rows the split touches,
 not with loop-body constants: an op whose operand is O(N) (the full
@@ -10,14 +10,78 @@ such op so the regression guard (tests/test_grow_jaxpr.py) fails loudly
 when one creeps back in, and the per-step profiler
 (scripts/profile_grow_steps.py) prints the same inventory as evidence.
 
-The audit is jaxpr-level: XLA-inserted copies are invisible here, but the
-copy-insertion pathologies observed so far were all driven by the jaxpr
-formulation (read-then-double-update chains on a carried buffer), so
-pinning the formulation pins the fix.
+The jaxpr audit is formulation-level: XLA-inserted copies are invisible
+here, but the copy-insertion pathologies observed so far were all driven
+by the jaxpr formulation (read-then-double-update chains on a carried
+buffer), so pinning the formulation pins the fix.
+
+:func:`hlo_collective_census` is the compiled-HLO complement for the
+GSPMD era (docs/DISTRIBUTED.md): with ``NamedSharding`` the compiler —
+not a call site — decides which collectives run, so the only honest
+accounting reads them back out of the compiled executable.  The census
+parses the post-optimization HLO text for collective ops with byte
+estimates from their result shapes; ``obs/collectives.hlo_census`` feeds
+it into the counter registry and bench telemetry.
 """
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Optional
+
+# every collective the XLA SPMD partitioner inserts; "-start" async
+# variants (TPU) are matched by prefix.  NOTE: on this jax/XLA a
+# feature-sharded reduction typically compiles to an all-reduce of the
+# SHARD-sized partial (each device computes only its output slice first)
+# — communication-equivalent to a reduce-scatter, so judge payload BYTES,
+# not op spelling, when pinning "no full-pool traffic".
+HLO_COLLECTIVE_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+                      "collective-permute", "all-to-all")
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8, "c64": 8, "c128": 16}
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([\d,]*)\]")
+
+
+def _shape_bytes(type_str: str) -> int:
+    """Total bytes of an HLO result type string — a single shape
+    (``f32[2,64,3]{2,1,0}``) or a tuple (``(f32[8], s32[8])``)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        elems = 1
+        for d in dims.split(","):
+            if d:
+                elems *= int(d)
+        total += elems * _DTYPE_BYTES[dtype]
+    return total
+
+
+def hlo_collective_census(compiled_or_text) -> Dict[str, Dict[str, int]]:
+    """Count compiler-inserted collectives in a compiled executable.
+
+    Accepts a compiled object (anything with ``as_text()``) or the HLO
+    text itself; returns ``{op: {"count", "bytes", "max_bytes"}}`` over
+    :data:`HLO_COLLECTIVE_OPS` (ops absent from the program are absent
+    from the dict).  ``bytes`` sums the result-shape payloads of every
+    STATIC occurrence — a collective inside a while body is counted once,
+    like the trace-time accounting of ``obs/collectives.note_collective``
+    it replaces on the GSPMD path."""
+    text = compiled_or_text if isinstance(compiled_or_text, str) \
+        else compiled_or_text.as_text()
+    out: Dict[str, Dict[str, int]] = {}
+    for op in HLO_COLLECTIVE_OPS:
+        # `%name = <type> all-reduce(...)` / `all-reduce-start(...)`
+        for m in re.finditer(
+                rf"=\s+(\(?[a-z0-9]+\[[^=]*?)\s+{op}(?:-start)?\(", text):
+            nb = _shape_bytes(m.group(1))
+            rec = out.setdefault(op, {"count": 0, "bytes": 0, "max_bytes": 0})
+            rec["count"] += 1
+            rec["bytes"] += nb
+            rec["max_bytes"] = max(rec["max_bytes"], nb)
+    return out
 
 
 def _aval_elems(v) -> int:

@@ -60,7 +60,8 @@ FLIPS = [
 ]
 COVERAGE = ["bench_1m_63bin.json", "bench_higgs_full.json",
             "bench_wide.json", "bench_sparse.json", "bench_leaves.json",
-            "bench_leaves_fused.json", "bench_serving.json"]
+            "bench_leaves_fused.json", "bench_serving.json",
+            "bench_mesh.json"]
 
 
 def load(path):
@@ -156,6 +157,42 @@ def serving_row(d):
             f"replay recompiles={s.get('recompiles')}")
 
 
+def mesh_rows(d):
+    """Per-shape lines for the mesh rung's shard_map-vs-GSPMD A/B
+    (bench.py BENCH_MESH=1, docs/DISTRIBUTED.md): trees/s per sharding,
+    the planner's chosen mesh, the in-pair ratio, and the compiled-HLO
+    collective census of the GSPMD executable.  A host-mesh rung: it
+    compares the collective FORMULATIONS, so the ratio is informational
+    — the parallel_impl default on TPU awaits an on-chip pair."""
+    m = d.get("mesh")
+    if not isinstance(m, dict):
+        return []
+    out = []
+    for shape, cfgs in (m.get("shapes") or {}).items():
+        parts = []
+        for name in ("gspmd_data", "gspmd_feature", "gspmd_auto",
+                     "shardmap_data"):
+            rec = cfgs.get(name)
+            if not isinstance(rec, dict):
+                continue
+            if "error" in rec:
+                parts.append(f"{name}=ERR")
+                continue
+            mesh_tag = f"@{rec['mesh']}" if rec.get("mesh") else ""
+            parts.append(f"{name}{mesh_tag}={rec.get('trees_per_sec')}")
+        ratio = cfgs.get("gspmd_vs_shardmap")
+        if ratio is not None:
+            parts.append(f"gspmd/shardmap={ratio}")
+        out.append(f"mesh[{shape}]: " + ", ".join(parts))
+        gd = cfgs.get("gspmd_data") or {}
+        cen = gd.get("collectives")
+        if isinstance(cen, dict) and cen:
+            ops = ", ".join(f"{op} {rec['count']}x/{rec['bytes']}B"
+                            for op, rec in sorted(cen.items()))
+            out.append(f"  gspmd collectives (compiled HLO): {ops}")
+    return out
+
+
 def main():
     cap = sys.argv[1]
     head = load(os.path.join(cap, "bench_1m.json"))
@@ -204,6 +241,8 @@ def main():
             sr = serving_row(d)
             if sr:
                 print(f"{'':53}{sr}")
+            for line in mesh_rows(d):
+                print(f"{'':53}{line}")
     for fname, knob, action, base_name in FLIPS:
         d = load(os.path.join(cap, fname))
         if d is None:

@@ -160,6 +160,18 @@ memsnap "serving"
 snap "serving rung"
 
 alive_or_abort "serving rung"
+echo "== mesh rung (GSPMD vs shard_map on the forced 8-device host mesh) ==" \
+    | tee -a "$OUT/log.txt"
+# host-mesh by construction (CPU devices stand in for chips): A/Bs the
+# collective FORMULATIONS — who inserts them, what payloads move (the
+# compiled-HLO census rides the JSON) — cheap even mid-tunnel since it
+# never touches the TPU; the on-chip default still awaits a real slice
+BENCH_MESH=1 BENCH_STAGE_TIMEOUT=1800 timeout 2100 python bench.py \
+    > "$OUT/bench_mesh.json" 2>> "$OUT/log.txt"
+cat "$OUT/bench_mesh.json" | tee -a "$OUT/log.txt"
+snap "mesh rung"
+
+alive_or_abort "mesh rung"
 echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_ordered_sort.jsonl" \

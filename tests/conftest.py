@@ -78,6 +78,13 @@ def pytest_collection_modifyitems(items):
     for item in items:
         if item.name in FAST_EXCEPTIONS:
             continue
+        # @pytest.mark.mesh8 is the opt-in the other way: a QUICK
+        # 8-logical-device mesh training inside a slow module stays in
+        # the fast tier, so tier-1 always carries a distributed-learner
+        # job (the whole suite already runs on the forced 8-device CPU
+        # mesh — see the XLA_FLAGS bootstrap above)
+        if item.get_closest_marker("mesh8") is not None:
+            continue
         if (item.module.__name__ in SLOW_MODULES
                 or item.name in SLOW_TESTS):
             item.add_marker(pytest.mark.slow)

@@ -157,6 +157,60 @@ def test_compiled_body_has_no_full_pool_copies():
         f"executable — the per-split fixed cost regression is back")
 
 
+# ---- order-carrier copy ratchet --------------------------------------------
+#
+# XLA copy-insertion clones the ``order`` carrier around the partition
+# switch's in-place scatter: a conditional branch that both slices and
+# scatters its operand gets a defensive copy (a minimal
+# slice-argsort-scatter-in-cond repro exhibits the same copies, so the
+# formulation cannot dodge it — the compiler won't cooperate).  One copy
+# executes per split (~1.85 MB at 200k rows, PR 9 residue).  The HLO text
+# carries one STATIC copy per gather-bucket branch; at this shape (N=32k,
+# bucket_min_log2=6 -> buckets 64..32768) that is 11 copies of
+# s32[N + maxbuf].  Pinned as a ratchet so sharding-annotation work (or a
+# toolchain move) can never silently multiply it — and the GSPMD grower,
+# which has no ``order`` carrier at all, is pinned copy-free below as the
+# contrast.
+
+ORDER_COPY_BUDGET = 11      # == the traced gather-bucket branch count
+
+
+def test_compiled_order_copy_count_ratchet():
+    grow, args = _grow_and_args()
+    txt = jax.jit(grow).lower(*args).compile().as_text()
+    carrier = N + 32768                       # order [N + maxbuf] i32
+    copies = re.findall(rf"= s32\[{carrier}\][^ ]* copy\(", txt)
+    assert 1 <= len(copies) <= ORDER_COPY_BUDGET, (
+        f"{len(copies)} order-carrier copies in the compiled executable "
+        f"(budget {ORDER_COPY_BUDGET} = one per partition-switch branch) "
+        f"— copy-insertion around the conditional in-place update has "
+        f"multiplied; re-measure deliberately before widening")
+
+
+def test_gspmd_grower_has_no_order_carrier_copies():
+    """The GSPMD grower's partition is the row_leaf map — no ``order``
+    permutation, no switch, no O(N) conditional carrier for XLA to
+    clone.  Pinned so the two growers' copy classes stay distinguishable
+    in perf work."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from lightgbm_tpu.parallel.gspmd import make_gspmd_grower
+    from lightgbm_tpu.parallel.mesh import BATCH_AXIS, make_named_mesh
+    cfg = GrowerConfig(num_leaves=L, min_data_in_leaf=1, max_bin=B,
+                       hist_method="segment")
+    _, args = _grow_and_args()
+    bins, g, h, c, meta, fv = args
+    mesh = make_named_mesh(8, 1)
+    grow = make_gspmd_grower(cfg, mesh)
+    rs = NamedSharding(mesh, P(BATCH_AXIS))
+    txt = grow.lower(
+        jax.device_put(bins, NamedSharding(mesh, P(BATCH_AXIS, None))),
+        jax.device_put(g, rs), jax.device_put(h, rs),
+        jax.device_put(c, rs), meta, fv).compile().as_text()
+    copies = re.findall(rf"= s32\[\d{{5,}}\][^ ]* copy\(", txt)
+    assert not copies, (
+        f"O(N) i32 copies appeared in the GSPMD grower: {copies[:4]}")
+
+
 # ---- byte-budget ratchet (obs/memory.executable_memory) -------------------
 #
 # The zero-copy HLO pin above catches the exact regression XLA exhibited;

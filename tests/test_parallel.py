@@ -32,6 +32,72 @@ def test_devices_available():
     assert len(jax.devices()) >= 8
 
 
+def _tiny_problem(n=2500, f=10, seed=5):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(f)
+    X = rng.randn(n, f)
+    y = (X @ w + 0.5 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def _tiny_train(extra, X, y):
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+              "min_data_in_leaf": 20}
+    params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4,
+                     verbose_eval=False)
+
+
+@pytest.mark.mesh8
+def test_gspmd_data_parallel_fast_tier():
+    """Tier-1's 8-logical-device job (conftest mesh8 opt-in): a quick
+    GSPMD data-parallel training must reproduce the serial trees and
+    actually run the NamedSharding path (not a silent serial
+    fallback)."""
+    X, y = _tiny_problem()
+    bs = _tiny_train({"tree_learner": "serial"}, X, y)
+    bg = _tiny_train({"tree_learner": "data"}, X, y)
+    assert bg.inner._parallel_impl == "gspmd"
+    assert bg.inner._gspmd_plan is not None
+    assert bg.inner._gspmd_plan.data > 1
+    for t_s, t_g in zip(bs.inner.models, bg.inner.models):
+        np.testing.assert_array_equal(t_s.split_feature, t_g.split_feature)
+        np.testing.assert_array_equal(t_s.threshold_bin, t_g.threshold_bin)
+
+
+@pytest.mark.mesh8
+def test_gspmd_vs_shardmap_ab_fast_tier():
+    """The forced A/B partner stays reachable: parallel_impl=shardmap on
+    the same data/learner trains the same trees through the explicit
+    psum choreography, so the pair is comparable by construction."""
+    X, y = _tiny_problem(seed=11)
+    bg = _tiny_train({"tree_learner": "data"}, X, y)
+    bm = _tiny_train({"tree_learner": "data",
+                      "parallel_impl": "shardmap"}, X, y)
+    assert bg.inner._parallel_impl == "gspmd"
+    assert bm.inner._parallel_impl == "shardmap"
+    assert bm.inner._gspmd_plan is None
+    for t_g, t_m in zip(bg.inner.models, bm.inner.models):
+        np.testing.assert_array_equal(t_g.split_feature, t_m.split_feature)
+        np.testing.assert_array_equal(t_g.threshold_bin, t_m.threshold_bin)
+
+
+@pytest.mark.mesh8
+def test_gspmd_voting_downgrades_to_shardmap_loudly():
+    """PV-tree vote compression IS call-site collective machinery; a
+    forced gspmd request on the voting learner resolves to shard_map
+    with a structured layout_downgrade event (the rung-honesty rule)."""
+    from lightgbm_tpu.obs.counters import counters as obs_counters
+    X, y = _tiny_problem(seed=13)
+    obs_counters.reset()
+    bv = _tiny_train({"tree_learner": "voting",
+                      "parallel_impl": "gspmd"}, X, y)
+    assert bv.inner._parallel_impl == "shardmap"
+    events = [e for e in obs_counters.events("layout_downgrade")
+              if e.get("requested") == "parallel_impl=gspmd"]
+    assert events and events[0]["resolved"] == "shardmap"
+
+
 def test_data_parallel_matches_serial(data):
     X, y, Xt, yt = data
     auc_serial, bst_s = _train_auc(X, y, Xt, yt, {"tree_learner": "serial"})
