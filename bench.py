@@ -56,6 +56,9 @@ zero recompiles via the predict_jit_entries gauge.  The "telemetry" block
 carries the OBSERVED histogram-kernel identity (lightgbm_tpu.obs dispatch
 counters) — if it disagrees with the rung label the result is marked
 degraded + kernel_mismatch so decide_flips.py refuses to compare it.
+"metrics_snapshot" embeds the live Prometheus sample map
+(obs/metrics.snapshot) next to "telemetry"/"memory" so
+scripts/obs_diff.py can regression-diff two rungs at the metrics level.
 BENCH_TRACE=<path> additionally writes a Chrome-trace span file for the
 measured child (render: `python -m lightgbm_tpu.obs <path>`).
 
@@ -651,6 +654,13 @@ def child_main():
         except Exception as e:       # the micro-rung never kills the bench
             serving = {"error": str(e)[:200]}
 
+    # live-metrics view of the measured child (obs/metrics.py): the same
+    # flat sample map a GET /metrics scrape would serve, embedded so
+    # scripts/obs_diff.py can regression-diff two rungs at the metrics
+    # level (decide_flips prints its coverage row)
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    metrics_snapshot = obs_metrics.snapshot()
+
     trace_file = obs_trace.stop() if bench_trace else None
     telemetry = {
         "observed_kernel": observed,
@@ -690,6 +700,7 @@ def child_main():
         "link": link,
         "telemetry": telemetry,
         "memory": memory_block,
+        "metrics_snapshot": metrics_snapshot,
     }
     if leaves_sweep is not None:
         result["leaves_sweep"] = leaves_sweep

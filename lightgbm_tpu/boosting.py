@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import copy
 import io
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -29,7 +30,10 @@ from .config import Config
 from .data.dataset import TrainingData
 from .grower import FeatureMeta, GrowerConfig, make_grower
 from .metrics import Metric, create_metric, default_metric_for_objective
+from .obs import collectives as obs_collectives
+from .obs import flight as obs_flight
 from .obs import memory as obs_memory
+from .obs import metrics as obs_metrics
 from .obs import trace as obs_trace
 from .obs.counters import counters as obs_counters
 from .ops.histogram import on_tpu
@@ -106,6 +110,7 @@ class GBDT:
         self.models: List[Tree] = []
         self.timers = PhaseTimers()   # TIMETAG analogue (gbdt.cpp:22-64)
         self.iter_ = 0
+        self._last_iter_leaves = 0
         self.num_init_iteration = 0
         self.boost_from_average_ = False
         self.best_iteration = -1
@@ -305,7 +310,29 @@ class GBDT:
         # call compiles anything, so a shape that cannot fit fails here in
         # milliseconds instead of minutes into a capture window.
         obs_memory.register_residents(self._memory_residents)
+        # live metrics source (obs/metrics.py): phase-timer families +
+        # iteration gauge for the /metrics scrape (weakly held, like the
+        # census providers)
+        obs_metrics.register_source(self._metrics_samples)
         self._memory_preflight(cfg, train)
+
+    def _metrics_samples(self) -> list:
+        """Live ``/metrics`` samples of this booster: per-phase totals and
+        steady-state means (first, compile-inclusive firing excluded — the
+        obs/report.py compile⚠ rule applied to the live view) plus the
+        iteration gauge.  Pure host-side dict reads; snapshot via ``list``
+        so a concurrent scrape never races the training thread's inserts."""
+        out = [("train_iterations", {}, float(self.iter_), "gauge")]
+        counts = dict(self.timers.counts)
+        for name, total in list(self.timers.seconds.items()):
+            labels = {"phase": name}
+            out.append(("phase_seconds", labels, float(total), "counter"))
+            out.append(("phase_iterations", labels,
+                        float(counts.get(name, 0)), "counter"))
+        for name, mean in self.timers.steady_means().items():
+            out.append(("phase_steady_ms", {"phase": name},
+                        float(mean) * 1e3, "gauge"))
+        return out
 
     def _memory_residents(self) -> Dict[str, list]:
         """Owner-tagged persistent device arrays for the live census
@@ -870,16 +897,42 @@ class GBDT:
         """One boosting iteration; returns True if training should stop
         (gbdt.cpp:465-581 TrainOneIter).  Each iteration is one telemetry
         span; the per-phase spans inside come from ``self.timers``."""
+        fl = obs_flight.get_flight()
+        t0 = time.perf_counter() if fl.enabled else 0.0
         with obs_trace.get_tracer().span("iteration", index=int(self.iter_)):
             stop = self._train_one_iter_inner(grad, hess)
         # per-iteration device-memory gauge (no-op singleton when memory
         # observability is off; armed it is a host-side read — it rides
         # the fetches the loop already does, adding no syncs of its own)
         obs_memory.get_memory().sample(site="iteration")
+        if fl.enabled:
+            # flight-recorder progress record: everything here is a
+            # host-side registry read — no device fetch, no collective
+            dt = time.perf_counter() - t0
+            rec: Dict[str, object] = {"seconds": round(dt, 6)}
+            if dt > 0:
+                rec["trees_per_sec"] = round(self.num_class / dt, 4)
+            leaves = self._last_iter_leaves
+            if leaves and dt > 0:
+                rec["ms_per_leaf"] = round(dt * 1e3 / leaves, 4)
+            kernel = obs_counters.observed_kernel()
+            if kernel:
+                rec["kernel"] = kernel
+            peak = obs_memory.get_memory().measured_peak()
+            if peak:
+                rec["hbm_peak_bytes"] = int(peak)
+            coll = obs_collectives.totals()
+            if coll["calls"]:
+                rec["collective_bytes"] = coll["bytes"]
+            fl.progress(int(self.iter_), **rec)
         return stop
 
     def _train_one_iter_inner(self, grad: Optional[np.ndarray] = None,
                               hess: Optional[np.ndarray] = None) -> bool:
+        # leaves this iteration actually split (known on the synchronous
+        # path only — pipelined trees drain later); the flight recorder's
+        # ms/leaf field rides it
+        self._last_iter_leaves = 0
         if (self.iter_ == 0 and self.num_init_iteration == 0
                 and self.allow_boost_from_average
                 and self.objective is not None
@@ -994,6 +1047,7 @@ class GBDT:
                     if not bool(nf_ok_h) \
                             and self._handle_nonfinite(k, bool(gh_ok_h)):
                         return False    # iteration rolled back; retry next
+                    self._last_iter_leaves += max(0, num_leaves - 1)
                     tree = Tree.from_arrays(
                         arrays, self.train_set.used_features,
                         self.train_set.bin_mappers, self._num_bin_host)

@@ -218,6 +218,30 @@ class Config:
                                    # telemetry; implies telemetry=true; render
                                    # with `python -m lightgbm_tpu.obs <path>`)
     telemetry: bool = False        # enable the telemetry counters/spans (docs/OBSERVABILITY.md) without writing a trace file
+    metrics_port: int = 0          # live metrics export (docs/OBSERVABILITY.md
+                                   # "Live telemetry"): > 0 serves the
+                                   # Prometheus text view of the telemetry
+                                   # registry on GET /metrics from a
+                                   # standalone exporter thread while
+                                   # training runs.  Rank R of a
+                                   # multi-process group binds
+                                   # metrics_port + R; the supervisor binds
+                                   # metrics_port itself and hands workers
+                                   # metrics_port + 1.  Host-side reads
+                                   # only — zero added collectives or
+                                   # device syncs; 0 = off
+    obs_stream_path: str = ""      # per-rank flight recorder
+                                   # (obs/flight.py): write a bounded,
+                                   # rotated JSONL event stream to
+                                   # <path>.rank_R — one iteration-stamped
+                                   # progress record per boosting
+                                   # iteration (trees/s, observed kernel,
+                                   # HBM peak, collective bytes) plus
+                                   # every structured obs event as it
+                                   # happens.  The supervisor tails all
+                                   # ranks' streams for straggler
+                                   # detection; "" = off
+    straggler_factor: float = 4.0  # supervisor straggler verdict: a rank whose flight-stream progress rate falls this factor behind the group median raises a structured rank_straggler event (requires obs_stream_path; must be > 1)
     convert_model: str = "gbdt_prediction.cpp"
     convert_model_language: str = ""
 
@@ -639,6 +663,13 @@ def check_param_conflicts(cfg: Config) -> None:
         log.fatal("hang_timeout (%g s) must exceed heartbeat_interval "
                   "(%g s): every rank would look hung between two stamps",
                   cfg.hang_timeout, cfg.heartbeat_interval)
+    if cfg.metrics_port < 0 or cfg.metrics_port > 65535:
+        log.fatal("metrics_port must be in [0, 65535] (0 = off); got %d",
+                  cfg.metrics_port)
+    if cfg.straggler_factor <= 1:
+        log.fatal("straggler_factor must be > 1 (a rank is a straggler "
+                  "when its progress rate falls that factor behind the "
+                  "group median); got %r", cfg.straggler_factor)
     if cfg.latency_budget_ms < 0:
         log.fatal("latency_budget_ms must be >= 0 (0 = dispatch "
                   "immediately); got %r", cfg.latency_budget_ms)

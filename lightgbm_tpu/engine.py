@@ -148,8 +148,30 @@ def train(params: Dict[str, Any], train_set: Dataset,
     snapshot_keep = int(params.get("snapshot_keep", -1) or -1)
     snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
     world = sync_mod.process_count()
-    rank = sync_mod.process_index()
+    # single-process identity: a supervisor may run several INDEPENDENT
+    # single-process workers under one prefix (LGBM_TPU_RANK env), whose
+    # liveness artifacts — heartbeats, crash reports, flight streams —
+    # must stay per-rank; distributed runs keep the jax process index
+    rank = sync_mod.process_index() if world > 1 \
+        else faults_mod.current_rank()
     single_process = world == 1
+    # ---- the live telemetry plane (docs/OBSERVABILITY.md) ----
+    # Both legs are scoped to THIS training (armed here, disarmed in the
+    # finally) and both are pure host-side observers: the flight recorder
+    # appends unsynced JSONL lines, the exporter serves scrapes off a
+    # daemon thread — zero added collectives / device syncs (pinned).
+    from .obs import flight as obs_flight
+    from .obs import metrics as obs_metrics
+    obs_stream = str(params.get("obs_stream_path", "") or "")
+    flight_armed = False
+    if obs_stream:
+        obs_flight.start(obs_flight.stream_path(obs_stream, rank), rank=rank)
+        flight_armed = True
+    metrics_port = int(params.get("metrics_port", 0) or 0)
+    exporter_armed = False
+    if metrics_port > 0:
+        obs_metrics.start_exporter(metrics_port + rank)
+        exporter_armed = True
     ckpt_callbacks = cbs_before + cbs_after   # stable capture/restore order
 
     def _write_checkpoint(iteration: int) -> None:
@@ -387,6 +409,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # trace file carries the whole memory story
             obs_memory.stop()
             obs_trace.stop()
+        if exporter_armed:
+            obs_metrics.stop_exporter()
+        if flight_armed:
+            # after memory/trace teardown so their final events (the
+            # memory_summary, late checkpoint events) still stream
+            obs_flight.stop()
         if fault_spec:
             faults_mod.restore(prev_faults)
     return booster

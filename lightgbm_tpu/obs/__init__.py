@@ -13,13 +13,22 @@ Four pillars (see docs/OBSERVABILITY.md):
   pre-compile ``hbm_budget`` pre-flight;
 * :mod:`.report` — ``python -m lightgbm_tpu.obs <trace>...`` renders the
   per-phase / per-kernel / memory markdown tables (multiple trace files
-  merge rank-tagged).
+  merge rank-tagged);
+* :mod:`.metrics` — the LIVE plane: a Prometheus text view of the whole
+  registry (counters/gauges, phase steady-state means, memory peaks,
+  serving latency histograms), served from ``GET /metrics`` on the
+  serving HTTP front and a standalone ``metrics_port`` exporter thread;
+* :mod:`.flight` — per-rank flight recorder: a bounded rotated JSONL
+  stream of iteration progress + structured events as they happen
+  (``obs_stream_path``), tailed by the supervisor for straggler verdicts.
 
 Enable from training via ``engine.train(params={"trace_path": ...})`` or
-``telemetry=true``; from the bench via ``BENCH_TRACE=<path>``.
+``telemetry=true``; from the bench via ``BENCH_TRACE=<path>``; the live
+plane via ``metrics_port`` / ``obs_stream_path``.
 """
-from . import memory, trace
+from . import flight, memory, metrics, trace
 from .counters import counters
 from .trace import get_tracer
 
-__all__ = ["memory", "trace", "counters", "get_tracer"]
+__all__ = ["flight", "memory", "metrics", "trace", "counters",
+           "get_tracer"]

@@ -101,6 +101,19 @@ def hlo_census(compiled_or_text, label: str = "grow") -> Dict[str, Dict[str, int
     return census
 
 
+def totals() -> Dict[str, int]:
+    """Aggregate collective traffic this process has accounted so far:
+    call-site counters (``note_collective``) plus the compiled-HLO census
+    (``hlo_census``) in one ``{"calls", "bytes"}`` pair — what the flight
+    recorder stamps into every progress record so a stream shows
+    communication growth over time."""
+    from .counters import counters
+    return {"calls": int(counters.total("collective_calls")
+                         + counters.total("hlo_collective_calls")),
+            "bytes": int(counters.total("collective_bytes")
+                         + counters.total("hlo_collective_bytes"))}
+
+
 @contextlib.contextmanager
 def intercept(records: Optional[List[Dict[str, Any]]] = None,
               count: bool = False):

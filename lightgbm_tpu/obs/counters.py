@@ -63,6 +63,7 @@ class CounterRegistry:
         self._events: collections.deque = collections.deque(
             maxlen=self.MAX_EVENTS)
         self._events_dropped = 0
+        self._sinks: List[Any] = []
 
     # ------------------------------------------------------------- writers
 
@@ -82,11 +83,29 @@ class CounterRegistry:
         and ``events_dropped`` counts the loss (surfaced in snapshots and
         the report) so truncation is visible, never silent."""
         from .trace import process_index   # lazy: avoid import cycles
+        ev = {"event": name, "proc": process_index(), **fields}
         with self._lock:
             if len(self._events) == self._events.maxlen:
                 self._events_dropped += 1
-            self._events.append({"event": name, "proc": process_index(),
-                                 **fields})
+            self._events.append(ev)
+            sinks = tuple(self._sinks)
+        for sink in sinks:       # outside the lock: a sink may take its own
+            try:
+                sink(ev)
+            except Exception:
+                pass             # a telemetry sink must never break emitters
+
+    def add_sink(self, fn) -> None:
+        """Subscribe a callable to every future structured event (the
+        flight recorder streams the ring to disk as it fills)."""
+        with self._lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
 
     def reset(self) -> None:
         with self._lock:

@@ -169,6 +169,7 @@ class MemoryMonitor:
 
     def __init__(self):
         self._peak = 0
+        self._flight_mark = 0
         self._last_census: Optional[Dict[str, Any]] = None
         stats = device_memory_stats()
         self.source = "memory_stats" if stats else "live_census"
@@ -191,6 +192,14 @@ class MemoryMonitor:
         self._peak = max(self._peak, peak)
         counters.gauge("memory_bytes_in_use", in_use)
         counters.gauge("memory_peak_bytes", self._peak)
+        if self._peak > self._flight_mark * 1.1:
+            # flight-recorder inflection: the peak grew >10% past its last
+            # streamed mark — a live stream shows WHEN memory jumped, not
+            # just the final number (no-op singleton when disarmed)
+            self._flight_mark = self._peak
+            from .flight import get_flight
+            get_flight().record("hbm_peak", peak_bytes=int(self._peak),
+                                site=site, source=self.source)
         return in_use
 
     def annotate(self, span) -> None:

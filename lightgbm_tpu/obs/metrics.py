@@ -1,0 +1,289 @@
+"""Live metrics plane: a process-wide Prometheus view of the telemetry
+registry, scrapeable WHILE training/serving runs.
+
+Every earlier obs leg (PR 2 counters/spans, PR 5 memory, PR 10 HLO census)
+is post-hoc: written at ``train()`` exit, read from files.  This module
+derives one *live* metrics view from the same sources — the
+:mod:`lightgbm_tpu.obs.counters` counters/gauges, the
+``utils/timer.PhaseTimers`` steady-state means, memory peaks, and the
+serving per-bucket latency stats — rendered in the Prometheus text
+exposition format (``text/plain; version=0.0.4``), and serves it two ways:
+
+* ``GET /metrics`` on the serving HTTP front
+  (:mod:`lightgbm_tpu.serving`);
+* a standalone exporter thread armed by the ``metrics_port`` param in
+  ``engine.train`` (bound at ``metrics_port + rank`` so multi-rank groups
+  never collide) and in the supervisor (which also exposes its restart
+  budget and per-rank heartbeat ages).
+
+Everything a scrape reads is host-side state — counter dicts, wall-clock
+totals, reservoir summaries.  Rendering never touches a device, issues a
+collective, or blocks the training loop (the PR 6-style zero-added-
+collectives pin extends over an armed exporter; ``tests/test_metrics.py``).
+Disarmed, the active exporter is the shared :data:`NULL_EXPORTER`
+singleton (the ``obs/trace.py`` discipline): arming is the only thing
+that allocates.
+
+Components register live sample *sources* (:func:`register_source`, weakly
+referenced like ``obs/memory.register_residents``): the boosting driver
+contributes phase-timer families, a ``ModelServer`` its per-bucket latency
+histograms, the supervisor its restart/heartbeat gauges.  A source
+returns ``[(name, labels, value, type), ...]``; names are prefixed
+``lgbm_tpu_`` and sanitized at render time.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .counters import counters
+
+PREFIX = "lgbm_tpu_"
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# stamped into snapshot() blocks (bench JSONs, obs_diff artifacts) so a
+# consumer can tell when the sample vocabulary changed shape
+SCHEMA_VERSION = 1
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize_name(name: str) -> str:
+    name = _NAME_OK.sub("_", str(name))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _label_value(v: Any) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _format_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_LABEL_OK.sub("_", str(k))}="{_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt(v: Any) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _split_tags(key: str) -> Dict[str, str]:
+    return dict(kv.split("=", 1) for kv in key.split(",") if "=" in kv)
+
+
+# ------------------------------------------------------------------ sources
+
+# weakly referenced zero-arg callables returning
+# [(name, labels, value, type), ...]; dead components drop out on render
+_sources: List[Any] = []
+
+
+def register_source(fn: Callable[[], list]) -> None:
+    """Register a live sample source (bound methods via ``WeakMethod`` so
+    a source never keeps its component alive)."""
+    try:
+        ref = weakref.WeakMethod(fn)
+    except TypeError:
+        ref = weakref.ref(fn)
+    _sources.append(ref)
+
+
+def _collect_sources() -> List[Tuple[str, Dict[str, Any], float, str]]:
+    out: List[Tuple[str, Dict[str, Any], float, str]] = []
+    live = []
+    for ref in _sources:
+        fn = ref()
+        if fn is None:
+            continue
+        live.append(ref)
+        try:
+            out.extend(fn())
+        except Exception:
+            # a scrape must never fail because one component is mid-
+            # teardown; the remaining families still render
+            continue
+    _sources[:] = live
+    return out
+
+
+# ---------------------------------------------------------------- rendering
+
+
+def _families() -> Dict[str, Tuple[str, Dict[str, float]]]:
+    """The full metrics view as ``{metric: (type, {label_str: value})}``.
+
+    Counter families (registry counters + source counters) sum across
+    duplicate series (two boosters contributing the same phase counter);
+    gauge duplicates resolve last-wins.
+    """
+    fams: Dict[str, Tuple[str, Dict[str, float]]] = {}
+
+    def add(name: str, labels: Dict[str, Any], value: float,
+            mtype: str) -> None:
+        metric = PREFIX + sanitize_name(name)
+        if mtype == "counter" and not metric.endswith("_total"):
+            metric += "_total"
+        mtype0, series = fams.setdefault(metric, (mtype, {}))
+        key = _format_labels(labels)
+        if mtype0 == "counter" and key in series:
+            series[key] += float(value)
+        else:
+            series[key] = float(value)
+
+    snap = counters.snapshot()
+    for name, buckets in snap["counters"].items():
+        for key, v in buckets.items():
+            add(name, _split_tags(key), v, "counter")
+    for name, v in snap["gauges"].items():
+        add(name, {}, v, "gauge")
+    add("events_dropped", {}, snap["events_dropped"], "counter")
+    add("process_index", {}, snap["process_index"], "gauge")
+    for name, labels, value, mtype in _collect_sources():
+        add(name, dict(labels or {}), value, mtype)
+    return fams
+
+
+def render_prometheus() -> str:
+    """The whole metrics view in Prometheus text exposition format."""
+    lines: List[str] = []
+    for metric, (mtype, series) in sorted(_families().items()):
+        lines.append(f"# TYPE {metric} "
+                     f"{'counter' if mtype == 'counter' else 'gauge'}")
+        for key, v in sorted(series.items()):
+            lines.append(f"{metric}{key} {_fmt(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def snapshot() -> Dict[str, Any]:
+    """Machine-readable twin of :func:`render_prometheus`: a flat
+    ``{"<metric>{labels}": value}`` sample map plus the schema version —
+    what ``bench.py`` embeds as the ``metrics_snapshot`` block and
+    ``scripts/obs_diff.py`` compares."""
+    samples: Dict[str, float] = {}
+    for metric, (_, series) in _families().items():
+        for key, v in series.items():
+            samples[metric + key] = v
+    return {"schema_version": SCHEMA_VERSION, "samples": samples}
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Inverse of :func:`render_prometheus` (sample-name fidelity only):
+    ``{"metric{labels}": value}``.  Comment/blank lines are skipped;
+    malformed lines are tolerated (a torn scrape is still comparable)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(" ", 1)
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+# ----------------------------------------------------------------- exporter
+
+
+class NullExporter:
+    """Disarmed exporter (the shared no-op singleton)."""
+    enabled = False
+    port: Optional[int] = None
+
+    def stop(self) -> None:
+        pass
+
+
+NULL_EXPORTER = NullExporter()
+
+
+class MetricsExporter:
+    """Standalone scrape endpoint: one daemon thread serving
+    ``GET /metrics`` (Prometheus text) and ``GET /healthz`` (JSON).
+    ``port`` is the actually bound port (pass 0 for an ephemeral one —
+    the *param* value 0 means "off" and never reaches here)."""
+    enabled = True
+
+    def __init__(self, port: int, host: str = ""):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from ..utils import log
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):       # noqa: N802 - stdlib API name
+                if self.path.startswith("/metrics"):
+                    body = render_prometheus().encode()
+                    ctype = CONTENT_TYPE
+                    code = 200
+                    counters.inc("metrics_scrapes")
+                elif self.path.startswith("/healthz"):
+                    body = json.dumps({"ok": True}).encode()
+                    ctype = "application/json"
+                    code = 200
+                else:
+                    body = b"unknown path; try /metrics\n"
+                    ctype = "text/plain"
+                    code = 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                log.debug("metrics exporter: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="lgbm-metrics-exporter",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+_active: Any = NULL_EXPORTER
+
+
+def get_exporter():
+    """The process-wide active exporter (NULL_EXPORTER when disarmed)."""
+    return _active
+
+
+def start_exporter(port: int):
+    """Arm the process-wide exporter on ``port`` (0 = ephemeral).  A bind
+    failure disarms loudly instead of killing the training/serving
+    process: live telemetry is an observer, never a dependency."""
+    global _active
+    from ..utils import log
+    stop_exporter()
+    try:
+        _active = MetricsExporter(port)
+    except OSError as e:
+        log.warning("metrics exporter: cannot bind port %s (%s); live "
+                    "scraping disabled for this process", port, e)
+        _active = NULL_EXPORTER
+        return _active
+    log.info("metrics exporter: GET /metrics on port %d", _active.port)
+    return _active
+
+
+def stop_exporter() -> None:
+    """Disarm and release the port (idempotent)."""
+    global _active
+    exp, _active = _active, NULL_EXPORTER
+    exp.stop()

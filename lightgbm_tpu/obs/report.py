@@ -20,6 +20,11 @@ import json
 import sys
 from typing import Any, Dict, List, Optional
 
+# --json output schema: 2 added the schema stamp itself plus the per-file
+# serving_stats / hlo_collectives entries (the multi-rank merge parity of
+# the markdown report)
+REPORT_SCHEMA_VERSION = 2
+
 
 def load_events(path: str) -> List[dict]:
     with open(path) as f:
@@ -189,18 +194,22 @@ def _memory_lines(snap: dict) -> List[str]:
 
 def _serving_lines(events: List[dict],
                    counters: Dict[str, Dict[str, float]],
-                   gauges: Dict[str, Any]) -> List[str]:
+                   gauges: Dict[str, Any],
+                   rank: Optional[int] = None) -> List[str]:
     """The report's Serving section: predict-executable dispatch identity
     (batch bucket + executable tag), the ``predict_jit_entries`` recompile
     gauge, and the server's per-bucket latency histograms/percentiles
-    (the ``serving stats`` summary the ModelServer flushes at stop)."""
+    (the ``serving stats`` summary the ModelServer flushes at stop).
+    ``rank`` titles the per-rank section of a multi-trace merge."""
     dispatch = counters.get("predict_dispatch", {})
     stats = summary_payload(events, "serving stats")
     jit_gauge = {k: v for k, v in gauges.items()
                  if k.endswith("predict_jit_entries")}
     if not (dispatch or stats):
         return []
-    lines = ["", "## Serving / predict", ""]
+    title = "## Serving / predict" + \
+        (f" — rank {rank}" if rank is not None else "")
+    lines = ["", title, ""]
     for k, v in sorted(jit_gauge.items()):
         lines.append(f"- `{k}` = {int(v)} compiled microbatch signature(s)")
     if dispatch:
@@ -335,16 +344,31 @@ def render(path) -> str:
     if hlo_calls:
         # compiler-inserted collectives (GSPMD): call-site counters can't
         # see these — the census reads the compiled executable
-        # (obs/collectives.hlo_census, docs/DISTRIBUTED.md)
+        # (obs/collectives.hlo_census, docs/DISTRIBUTED.md).  In a
+        # multi-trace merge the counter keys carry the proc tag, so the
+        # table keeps every rank's census attributable
         hlo_bytes = counters.get("hlo_collective_bytes", {})
+        with_proc = any("proc=" in k for k in hlo_calls)
         lines += ["", "## Compiled-HLO collective census "
                   "(compiler-inserted)", ""]
         lines += _md_table(
-            ["op", "executable", "ops", "bytes"],
-            [[_split_tags(k).get("op", "?"),
-              _split_tags(k).get("label", "-"), int(v),
-              int(hlo_bytes.get(k, 0))] for k, v in sorted(hlo_calls.items())])
-    lines += _serving_lines(events, counters, snap.get("gauges", {}))
+            (["rank"] if with_proc else []) + ["op", "executable", "ops",
+                                               "bytes"],
+            [([_split_tags(k).get("proc", "-")] if with_proc else [])
+             + [_split_tags(k).get("op", "?"),
+                _split_tags(k).get("label", "-"), int(v),
+                int(hlo_bytes.get(k, 0))]
+             for k, v in sorted(hlo_calls.items())])
+    if multi:
+        # per-rank serving sections: the stats payload is per-file (one
+        # serving process per trace), so it must never merge/overwrite —
+        # PR 5 left this section single-trace only
+        for p, rank, evs in ranked:
+            rsnap = summary_payload(evs, "counters") or {}
+            lines += _serving_lines(evs, rsnap.get("counters", {}),
+                                    rsnap.get("gauges", {}), rank=rank)
+    else:
+        lines += _serving_lines(events, counters, snap.get("gauges", {}))
     lines += _memory_lines(snap)
     events_list = snap.get("events", [])
     if events_list:
@@ -391,9 +415,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "memory": {
                         k: v for k, v in summary.get("gauges", {}).items()
                         if k.startswith(("memory_", "hbm_", "exec_"))},
+                    # per-rank serving + census entries (the merged-report
+                    # parity): one serving process per trace file
+                    "serving_stats": summary_payload(events,
+                                                     "serving stats"),
+                    "hlo_collectives": summary.get("counters", {}).get(
+                        "hlo_collective_calls", {}),
                     "events_dropped": summary.get("events_dropped", 0),
                     "summary": summary})
             doc = files[0] if len(files) == 1 else {"files": files}
+            doc["schema_version"] = REPORT_SCHEMA_VERSION
             print(json.dumps(doc, indent=1))
         else:
             print(render(argv))

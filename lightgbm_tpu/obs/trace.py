@@ -183,6 +183,10 @@ class Tracer:
         so the trace file is self-contained."""
         path = path or self.path
         from .counters import counters  # lazy: avoid import cycles
+        from . import metrics as obs_metrics
+        # the live-scrape view rides along so obs_diff can compare two
+        # traces at the metrics level without a /metrics endpoint
+        self.summary("metrics", obs_metrics.snapshot())
         self.summary("counters", counters.snapshot())
         if not path:
             return None

@@ -43,6 +43,7 @@ import numpy as np
 
 from . import checkpoint as checkpoint_mod
 from .config import config_from_params, parse_serving_buckets
+from .obs import metrics as obs_metrics
 from .obs import trace as obs_trace
 from .obs.counters import counters as obs_counters
 from .utils import log
@@ -157,6 +158,15 @@ class ModelServer:
         self.stats_ = ServingStats()
         self._running = False
         self._threads: List[threading.Thread] = []
+        # live metrics plane (docs/OBSERVABILITY.md "Live telemetry"):
+        # the per-bucket latency stats become scrapeable families on
+        # GET /metrics — on this server's HTTP front and, when
+        # metrics_port is set, a standalone exporter thread
+        obs_metrics.register_source(self._metrics_samples)
+        self._own_exporter = None
+        if int(cfg.metrics_port) > 0:
+            self._own_exporter = obs_metrics.start_exporter(
+                int(cfg.metrics_port))
         if booster is not None:
             self._install(booster, iteration=None, prewarm=prewarm)
         elif self.watch_prefix:
@@ -252,6 +262,42 @@ class ModelServer:
         s["loaded_iteration"] = self.loaded_iteration
         s["predict_jit_entries"] = _jit_entries_gauge()
         return s
+
+    def _metrics_samples(self) -> List[tuple]:
+        """Live ``/metrics`` families of this server: throughput counters,
+        the loaded iteration / jit-entry gauges, and per-bucket latency —
+        p50/p99/max gauges plus a windowed Prometheus histogram derived
+        from the reservoir's edge counts (the reservoir keeps the newest
+        ``ServingStats.RESERVOIR`` latencies, so the histogram is a
+        sliding window, not an all-time cumulative).  Host-side reads
+        only."""
+        from .inference import jit_entries
+        s = self.stats_.summary()
+        # the registry already carries serving_requests / serving_batches
+        # / serving_model_swap counters from the dispatch path — this
+        # source only adds what no counter records
+        out = [
+            ("serving_rows", {}, float(s["rows"]), "counter"),
+            ("serving_loaded_iteration", {},
+             float(-1 if self.loaded_iteration is None
+                   else self.loaded_iteration), "gauge"),
+            ("serving_jit_entries", {}, float(jit_entries()), "gauge"),
+        ]
+        for bucket, rec in s.get("buckets", {}).items():
+            labels = {"bucket": bucket}
+            for q in ("p50_ms", "p99_ms", "max_ms"):
+                out.append((f"serving_{q}", labels, float(rec[q]), "gauge"))
+            cum = 0.0
+            for edge in _HIST_EDGES_MS:
+                cum += float(rec["hist"].get(f"<={edge}ms", 0))
+                out.append(("serving_latency_ms_bucket",
+                            dict(labels, le=str(edge)), cum, "gauge"))
+            out.append(("serving_latency_ms_bucket",
+                        dict(labels, le="+Inf"), float(rec["count"]),
+                        "gauge"))
+            out.append(("serving_latency_ms_count", labels,
+                        float(rec["count"]), "gauge"))
+        return out
 
     # ---------------------------------------------------------- dispatcher
 
@@ -353,6 +399,12 @@ class ModelServer:
         for t in self._threads:
             t.join(timeout=5.0)
         self._threads = []
+        if self._own_exporter is not None:
+            # only the exporter THIS server armed — never one the engine
+            # or supervisor owns in the same process
+            if obs_metrics.get_exporter() is self._own_exporter:
+                obs_metrics.stop_exporter()
+            self._own_exporter = None
         s = self.stats()
         obs_trace.get_tracer().summary("serving stats", s)
         return s
@@ -370,7 +422,8 @@ def _jit_entries_gauge() -> int:
 
 def _run_http(server: ModelServer, port: int) -> None:
     """Minimal stdlib HTTP front: POST /predict {"data": [[...]...]} ->
-    {"predictions": [...]}; GET /stats, GET /healthz."""
+    {"predictions": [...]}; GET /stats, GET /healthz, GET /metrics
+    (Prometheus text — the live telemetry plane's scrape point)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -389,6 +442,14 @@ def _run_http(server: ModelServer, port: int) -> None:
                                      server.loaded_iteration})
             elif self.path.startswith("/stats"):
                 self._json(200, server.stats())
+            elif self.path.startswith("/metrics"):
+                obs_counters.inc("metrics_scrapes")
+                body = obs_metrics.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", obs_metrics.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": "unknown path"})
 
@@ -444,6 +505,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "swap from (model_watch param)")
     ap.add_argument("--port", type=int, default=8080,
                     help="HTTP port (ignored under --replay)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="standalone Prometheus exporter port (the "
+                         "metrics_port param; GET /metrics also rides "
+                         "the main HTTP front)")
     ap.add_argument("--latency-budget-ms", type=float, default=None)
     ap.add_argument("--buckets", default=None,
                     help="serving_buckets ladder, e.g. 1,8,64,512,4096")
@@ -465,6 +530,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         params["model_watch"] = args.watch
     if args.watch_interval is not None:
         params["model_watch_interval"] = args.watch_interval
+    if args.metrics_port is not None:
+        params["metrics_port"] = args.metrics_port
     server = ModelServer(model_file=args.model or None, params=params)
     if args.replay:
         stats = _run_replay(server, args.replay, args.features)

@@ -54,6 +54,15 @@ On either signal the supervisor runs one **restart cycle**:
 Every decision is a structured obs event — ``rank_dead`` / ``rank_hang`` /
 ``group_restart`` / ``restart_budget_exhausted`` / ``crash_report`` /
 ``stale_sweep`` — an unattended recovery is never an unexplained one.
+
+The live telemetry plane (docs/OBSERVABILITY.md "Live telemetry") extends
+liveness to *health*: with ``obs_stream_path`` set the supervisor tails
+every rank's flight-recorder stream and compares per-rank progress rates
+— a rank ``straggler_factor`` behind the group median raises a structured
+``rank_straggler`` event (a verdict, never a restart); with
+``metrics_port`` set the supervisor serves its restart budget, last
+restart time, and per-rank heartbeat ages as Prometheus gauges, so one
+scrape answers "is this group healthy".
 """
 from __future__ import annotations
 
@@ -64,6 +73,8 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import checkpoint as checkpoint_mod
+from .obs import flight as flight_mod
+from .obs import metrics as metrics_mod
 from .obs.counters import counters
 from .utils import log
 
@@ -127,7 +138,11 @@ class Supervisor:
                  startup_grace: Optional[float] = None,
                  poll_interval: float = 0.1,
                  env: Optional[Dict[str, str]] = None,
-                 prelaunch: Optional[Callable[["Supervisor"], None]] = None):
+                 prelaunch: Optional[Callable[["Supervisor"], None]] = None,
+                 obs_stream: str = "",
+                 straggler_factor: float = 4.0,
+                 straggler_interval: float = 1.0,
+                 metrics_port: int = 0):
         self.argv = list(argv)
         self.output_model = str(output_model)
         self.world = max(1, int(world))
@@ -151,6 +166,43 @@ class Supervisor:
         self.attempt = 0              # total relaunches so far
         self._ranks: List[_Rank] = []
         self._progress_mark: Optional[int] = None
+        # live telemetry plane (docs/OBSERVABILITY.md "Live telemetry"):
+        # the flight streams every rank appends under obs_stream are
+        # tailed for straggler verdicts (rate vs group median), and the
+        # supervisor's own restart state is exposed as scrape gauges
+        self.obs_stream = str(obs_stream or "")
+        self.straggler_factor = max(1.001, float(straggler_factor))
+        self.straggler_interval = max(0.1, float(straggler_interval))
+        self.metrics_port = int(metrics_port or 0)
+        self._restarts_since_progress = 0
+        self._last_restart_unix = 0.0
+        self._last_straggler_check = 0.0
+        self._stragglers_flagged: set = set()
+        metrics_mod.register_source(self._metrics_samples)
+
+    def _metrics_samples(self) -> list:
+        """Live ``/metrics`` view of group health: one scrape answers "is
+        this group healthy" — the remaining restart budget, the last
+        restart time, and every rank's heartbeat age (read fresh from the
+        heartbeat files at scrape time; -1 = never stamped)."""
+        out = [
+            ("restart_budget_remaining", {},
+             float(max(0, self.restart_limit
+                       - self._restarts_since_progress)), "gauge"),
+            ("last_restart_unix", {}, float(self._last_restart_unix),
+             "gauge"),
+            ("supervisor_restarts", {}, float(self.attempt), "counter"),
+            ("supervisor_world", {}, float(self.world), "gauge"),
+        ]
+        for r in range(self.world):
+            hb = checkpoint_mod.read_heartbeat(
+                checkpoint_mod.heartbeat_path(self.output_model, r))
+            out.append(("rank_heartbeat_age_seconds", {"rank": str(r)},
+                        float(hb[1]) if hb else -1.0, "gauge"))
+            if hb:
+                out.append(("rank_iteration", {"rank": str(r)},
+                            float(hb[0]), "gauge"))
+        return out
 
     # ------------------------------------------------------------- lifecycle
 
@@ -166,7 +218,18 @@ class Supervisor:
                                        crash_reports=True, heartbeats=True)
         self._progress_mark = checkpoint_mod.latest_committed_iteration(
             self.output_model)
-        restarts_since_progress = 0
+        exporter_armed = False
+        if self.metrics_port > 0:
+            metrics_mod.start_exporter(self.metrics_port)
+            exporter_armed = True
+        try:
+            return self._run_loop()
+        finally:
+            if exporter_armed:
+                metrics_mod.stop_exporter()
+
+    def _run_loop(self) -> int:
+        self._restarts_since_progress = 0
         self._launch()
         while True:
             time.sleep(self.poll_interval)
@@ -187,8 +250,9 @@ class Supervisor:
                 # forward progress since the last restart: the job is
                 # advancing between failures — refill the budget
                 self._progress_mark = it
-                restarts_since_progress = 0
-            restarts_since_progress += 1
+                self._restarts_since_progress = 0
+            self._restarts_since_progress += 1
+            restarts_since_progress = self._restarts_since_progress
             if restarts_since_progress > self.restart_limit:
                 counters.event("restart_budget_exhausted",
                                limit=self.restart_limit,
@@ -203,6 +267,11 @@ class Supervisor:
                 return 1
             delay = self.restart_backoff * (2 ** (restarts_since_progress - 1))
             self.attempt += 1
+            self._last_restart_unix = time.time()
+            counters.gauge("restart_budget_remaining",
+                           max(0, self.restart_limit
+                               - restarts_since_progress))
+            counters.gauge("last_restart_unix", self._last_restart_unix)
             counters.event("group_restart", attempt=self.attempt,
                            restarts_since_progress=restarts_since_progress,
                            resume_iteration=it, backoff=delay,
@@ -277,7 +346,44 @@ class Supervisor:
                 return ("rank_hang", rk.rank,
                         f"heartbeat {age:.1f}s old (timeout {deadline:g}s"
                         + ("" if hb else ", never stamped") + ")")
+        self._straggler_check(now)
         return None
+
+    def _straggler_check(self, now: float) -> None:
+        """Health beyond liveness: tail every rank's flight stream
+        (``obs_stream_path``) and compare per-rank progress RATES.  A rank
+        a ``straggler_factor`` behind the group median raises one
+        structured ``rank_straggler`` event per incarnation — a verdict,
+        not a restart trigger: a slow rank is making progress, and
+        restarting it would destroy exactly the evidence an operator
+        needs.  Host-side file reads, throttled to
+        ``straggler_interval``."""
+        if not self.obs_stream \
+                or now - self._last_straggler_check < self.straggler_interval:
+            return
+        self._last_straggler_check = now
+        rates = {}
+        for r in range(self.world):
+            recs = flight_mod.tail_records(
+                flight_mod.stream_path(self.obs_stream, r))
+            rates[r] = flight_mod.progress_rate(recs)
+        for s in flight_mod.detect_stragglers(rates, self.straggler_factor):
+            key = (s["rank"], self.attempt)
+            if key in self._stragglers_flagged:
+                continue
+            self._stragglers_flagged.add(key)
+            counters.event("rank_straggler", rank=s["rank"],
+                           rate=s["rate"], median_rate=s["median_rate"],
+                           behind=s["behind"],
+                           factor=self.straggler_factor,
+                           attempt=self.attempt)
+            counters.gauge(f"rank_straggler_behind_r{s['rank']}",
+                           s["behind"])
+            log.warning("Supervisor: rank %d is a straggler — %.3g it/s "
+                        "vs group median %.3g (%.3gx behind, threshold "
+                        "%gx); group is alive but not healthy",
+                        s["rank"], s["rate"], s["median_rate"],
+                        s["behind"], self.straggler_factor)
 
     # ------------------------------------------------------------- teardown
 
@@ -335,6 +441,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     worker_argv = ([sys.executable, "-m", "lightgbm_tpu.cli"] + argv +
                    [f"heartbeat_interval={heartbeat}",
                     "snapshot_resume=true"])
+    if cfg.metrics_port > 0:
+        # the supervisor's own exporter binds metrics_port; workers get
+        # metrics_port + 1 and each rank adds its process index on top
+        # (engine.train), so one group scrapes at P, P+1, P+2, ...
+        worker_argv.append(f"metrics_port={cfg.metrics_port + 1}")
     prelaunch = None
     if cfg.num_machines > 1 and cfg.machine_list_file:
         from .parallel import mesh
@@ -350,10 +461,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         restart_limit=cfg.restart_limit,
         restart_backoff=cfg.restart_backoff,
         collective_timeout=cfg.collective_timeout,
-        collective_retries=cfg.collective_retries, prelaunch=prelaunch)
+        collective_retries=cfg.collective_retries, prelaunch=prelaunch,
+        obs_stream=cfg.obs_stream_path,
+        straggler_factor=cfg.straggler_factor,
+        metrics_port=cfg.metrics_port)
     rc = sup.run()
     for name in ("rank_dead", "rank_hang", "group_restart",
-                 "restart_budget_exhausted"):
+                 "restart_budget_exhausted", "rank_straggler"):
         for e in counters.events(name):
             log.info("supervisor event: %s", e)
     return rc

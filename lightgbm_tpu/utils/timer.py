@@ -28,6 +28,11 @@ class PhaseTimers:
     def __init__(self):
         self.seconds: Dict[str, float] = collections.defaultdict(float)
         self.counts: Dict[str, int] = collections.defaultdict(int)
+        # first recorded duration per phase: a first firing that includes
+        # a jit compile poisons the mean (the obs/report.py compile⚠
+        # separation) — kept here so the LIVE metrics view can serve
+        # steady-state means, not just totals
+        self.first: Dict[str, float] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -42,12 +47,24 @@ class PhaseTimers:
             # memory monitor are armed; the sample is a host-side read)
             obs_memory.get_memory().annotate(span)
             span.__exit__(None, None, None)
-            self.seconds[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+            self.add(name, time.perf_counter() - t0)
 
     def add(self, name: str, seconds: float) -> None:
         self.seconds[name] += seconds
         self.counts[name] += 1
+        self.first.setdefault(name, seconds)
+
+    def steady_means(self) -> Dict[str, float]:
+        """Mean seconds per phase with the first (possibly
+        compile-inclusive) firing excluded; a single-firing phase reports
+        that firing."""
+        out: Dict[str, float] = {}
+        for name, total in list(self.seconds.items()):
+            n = self.counts.get(name, 0)
+            first = self.first.get(name, 0.0)
+            out[name] = ((total - first) / (n - 1)) if n > 1 \
+                else (first if n else 0.0)
+        return out
 
     def report(self, header: str = "phase timers") -> str:
         parts = [f"{k}: {v:.3f}s/{self.counts[k]}x"
@@ -63,3 +80,4 @@ class PhaseTimers:
     def reset(self) -> None:
         self.seconds.clear()
         self.counts.clear()
+        self.first.clear()

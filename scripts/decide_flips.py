@@ -14,9 +14,27 @@ reads.
 
 Usage: python scripts/decide_flips.py docs/tpu_capture_<stamp>/
 """
+import importlib.util
 import json
 import os
 import sys
+
+
+_OBS_DIFF = None
+
+
+def _load_obs_diff():
+    """scripts/ is not a package; load the sibling regression differ by
+    path (the tests' _load_script idiom), once."""
+    global _OBS_DIFF
+    if _OBS_DIFF is None:
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "obs_diff.py")
+        spec = importlib.util.spec_from_file_location("obs_diff", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _OBS_DIFF = mod
+    return _OBS_DIFF
 
 # (artifact, knob, action, baseline_artifact or None=headline)
 FLIPS = [
@@ -62,6 +80,10 @@ COVERAGE = ["bench_1m_63bin.json", "bench_higgs_full.json",
             "bench_wide.json", "bench_sparse.json", "bench_leaves.json",
             "bench_leaves_fused.json", "bench_serving.json",
             "bench_mesh.json"]
+# scripts/obs_diff.py thresholds for the in-pair drift annotations (the
+# same defaults the CLI uses)
+_DIFF_THRESHOLDS = {"throughput_pct": 10.0, "latency_pct": 25.0,
+                    "p99_pct": 25.0, "memory_pct": 20.0}
 
 
 def load(path):
@@ -124,6 +146,18 @@ def memory_row(d):
             f"{meas / 1e9:.3f} GB ({m.get('measured_source')}"
             f"{f', x{ratio} of model' if ratio is not None else ''}"
             f"{f', capacity {cap_b / 1e9:.1f} GB' if cap_b else ''})")
+
+
+def metrics_row(d):
+    """One-line coverage summary of an artifact's "metrics_snapshot"
+    block (the live /metrics sample map bench.py embeds next to
+    telemetry/memory; obs/metrics.py is the producer).  None when the
+    artifact predates the live telemetry plane."""
+    m = d.get("metrics_snapshot")
+    if not isinstance(m, dict):
+        return None
+    return (f"metrics: {len(m.get('samples', {}))} live samples "
+            f"(schema v{m.get('schema_version')})")
 
 
 def observed_split_find(d):
@@ -211,6 +245,9 @@ def main():
     hs = serving_row(head)
     if hs:
         print(f"{'':10}{hs}")
+    hx = metrics_row(head)
+    if hx:
+        print(f"{'':10}{hx}")
     if not deciding:
         print("headline is not a clean TPU number -> NO flip decisions "
               "from this capture; table below is informational only")
@@ -241,6 +278,9 @@ def main():
             sr = serving_row(d)
             if sr:
                 print(f"{'':53}{sr}")
+            xr = metrics_row(d)
+            if xr:
+                print(f"{'':53}{xr}")
             for line in mesh_rows(d):
                 print(f"{'':53}{line}")
     for fname, knob, action, base_name in FLIPS:
@@ -277,6 +317,15 @@ def main():
         print(f"{fname:34} {d['value']:>9} {ratio:>8.3f}  {verdict}: {knob}")
         if verdict == "WIN":
             print(f"{'':53}-> {action}")
+        # non-throughput drift between the pair (memory peaks, serving
+        # percentiles, identity flags) via the shared regression differ —
+        # a WIN that doubled its p99 or HBM peak should not flip quietly
+        diff = _load_obs_diff()
+        for x in diff.compare_bench(base, d, _DIFF_THRESHOLDS):
+            if x["check"] == "throughput" or x["severity"] == "info":
+                continue
+            print(f"{'':53}obs_diff {x['severity'].upper()} {x['check']}: "
+                  f"{x['detail']}")
     mp = load(os.path.join(cap, "microprobe.json"))
     if mp:
         print()

@@ -80,6 +80,23 @@ timeout 300 python -m lightgbm_tpu.obs "$OUT/trace_1m.jsonl" \
 timeout 300 python -m lightgbm_tpu.obs --json "$OUT/trace_1m.jsonl" \
     > "$OUT/trace_1m.report.json" 2>> "$OUT/log.txt" || true
 memsnap "1m"
+# automated before/after verdict vs the newest PREVIOUS committed capture
+# (scripts/obs_diff.py): a silent headline regression is flagged in-window
+# instead of being eyeballed from two JSONs weeks apart.  Nonzero exit =
+# regression; informational here (the capture continues), but the verdict
+# file rides the commit for decide_flips/CI.
+PREV=$(ls -d docs/tpu_capture_* 2>/dev/null | grep -vF "$OUT" | sort | tail -1)
+if [ -n "$PREV" ] && [ -f "$PREV/bench_1m.json" ]; then
+    if timeout 300 python scripts/obs_diff.py "$PREV/bench_1m.json" \
+            "$OUT/bench_1m.json" > "$OUT/obs_diff_1m.txt" 2>&1; then
+        echo "obs_diff: headline within thresholds vs $PREV" \
+            | tee -a "$OUT/log.txt"
+    else
+        echo "obs_diff: HEADLINE REGRESSION vs $PREV (obs_diff_1m.txt)" \
+            | tee -a "$OUT/log.txt"
+    fi
+    cat "$OUT/obs_diff_1m.txt" >> "$OUT/log.txt" || true
+fi
 echo "jax_cache entries: $(ls .jax_cache 2>/dev/null | wc -l)" \
     | tee -a "$OUT/log.txt"   # nonzero growth => TPU executables persist
 snap "headline bench"

@@ -72,10 +72,19 @@ def test_bench_child_embeds_memory_block():
     assert r.returncode == 0, r.stderr[-2000:]
     line = [ln for ln in r.stdout.strip().splitlines()
             if ln.startswith("{")][-1]
-    mem = json.loads(line)["memory"]
+    doc = json.loads(line)
+    mem = doc["memory"]
     for key in ("predicted_peak_bytes", "predicted_resident_bytes",
                 "measured_peak_bytes", "measured_source", "top_residents"):
         assert key in mem, f"memory block missing {key}"
+    # the live-telemetry PR's twin contract: every bench JSON also embeds
+    # the metrics_snapshot block (obs/metrics.snapshot — the flat
+    # /metrics sample map scripts/obs_diff.py compares)
+    ms = doc["metrics_snapshot"]
+    assert ms["schema_version"] >= 1
+    assert any(k.startswith("lgbm_tpu_hist_dispatch_total")
+               for k in ms["samples"]), sorted(ms["samples"])[:10]
+    assert "lgbm_tpu_memory_peak_bytes" in ms["samples"]
     assert mem["measured_source"] == "live_census"
     assert mem["measured_peak_bytes"] > 0
     # tiny shapes carry proportionally more fixed overhead than the bench

@@ -260,12 +260,36 @@ def test_cli_merges_multiple_traces_rank_tagged(tmp_path):
         tr.proc = rank                   # what a rank-r process would stamp
         with tr.span("iteration", index=0):
             pass
+        # per-rank serving stats + HLO census (what a GSPMD rank running
+        # a server would embed): the merged report must keep BOTH ranks'
+        # sections, not just the last file's
+        counters.reset()
+        counters.inc("hlo_collective_calls", value=2 + rank,
+                     op="all-reduce", label="grow")
+        counters.inc("hlo_collective_bytes", value=1024 * (rank + 1),
+                     op="all-reduce", label="grow")
+        tr.summary("serving stats",
+                   {"requests": 10 + rank, "rows": 100, "batches": 3,
+                    "qps": 5.0, "rows_per_s": 50.0, "swaps": 0,
+                    "buckets": {"64": {"count": 10, "p50_ms": 1.0 + rank,
+                                       "p99_ms": 2.0, "max_ms": 3.0,
+                                       "hist": {"<=1ms": 10}}}})
         tr.write()
         paths.append(p)
+    counters.reset()
     text = obs_report.render(paths)
     assert "[r0] iteration" in text and "[r1] iteration" in text
     assert "rank 0" in text and "rank 1" in text
-    # the --json twin carries one entry per file with its rank
+    # per-rank serving sections (PR 5 left this single-trace only)
+    assert "## Serving / predict — rank 0" in text
+    assert "## Serving / predict — rank 1" in text
+    assert "10 requests" in text and "11 requests" in text
+    # the census table keeps every rank's row attributable
+    census = text.split("Compiled-HLO collective census", 1)[1]
+    assert "| 0 | all-reduce | grow | 2 | 1024 |" in census
+    assert "| 1 | all-reduce | grow | 3 | 2048 |" in census
+    # the --json twin carries one entry per file with its rank, the
+    # per-rank serving/census entries, and a schema stamp
     r = subprocess.run(
         [sys.executable, "-m", "lightgbm_tpu.obs", "--json", *paths],
         capture_output=True, text=True, cwd=ROOT, timeout=240,
@@ -274,7 +298,11 @@ def test_cli_merges_multiple_traces_rank_tagged(tmp_path):
                  + os.environ.get("PYTHONPATH", "")))
     assert r.returncode == 0, r.stderr[-2000:]
     doc = json.loads(r.stdout)
+    assert doc["schema_version"] == obs_report.REPORT_SCHEMA_VERSION
     assert [f["rank"] for f in doc["files"]] == [0, 1]
+    assert [f["serving_stats"]["requests"] for f in doc["files"]] == [10, 11]
+    assert all("op=all-reduce" in ",".join(f["hlo_collectives"])
+               for f in doc["files"])
 
 
 # ---------------------------------------------------------------- collectives
