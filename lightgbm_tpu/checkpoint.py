@@ -79,6 +79,23 @@ _SNAP_RE = re.compile(r"\.snapshot_iter_(\d+)$")
 _SHARD_RE = re.compile(r"\.snapshot_iter_(\d+)\.rank_(\d+)$")
 _MANIFEST_RE = re.compile(r"\.snapshot_iter_(\d+)\.manifest$")
 
+# incarnation epoch fence (docs/ROBUSTNESS.md "Elastic groups"): the
+# supervisor stamps each (re)launch's attempt counter into this env var;
+# sync.py carries it in every collective payload header so a stale process
+# from a dead incarnation can never join the new group, and every liveness
+# artifact (heartbeat / crash report / flight stream) is stamped with it
+# so dead-incarnation leftovers are distinguishable and sweepable
+GROUP_EPOCH_ENV = "LGBM_TPU_GROUP_EPOCH"
+
+
+def group_epoch() -> int:
+    """The incarnation epoch this process was launched under (0 when not
+    running under an epoch-stamping supervisor)."""
+    try:
+        return int(os.environ.get(GROUP_EPOCH_ENV, "0") or 0)
+    except ValueError:
+        return 0
+
 
 class CheckpointError(RuntimeError):
     """The file is not a valid checkpoint (torn tail, bad CRC, bad blob)."""
@@ -274,7 +291,8 @@ class Heartbeat:
             return
         self._last = now
         line = json.dumps({"iteration": int(iteration), "time": now,
-                           "pid": os.getpid()}) + "\n"
+                           "pid": os.getpid(),
+                           "epoch": group_epoch()}) + "\n"
         # atomic but UNSYNCED: a heartbeat that evaporates in a crash is
         # indistinguishable from the death it would have reported anyway
         tmp = f"{self.path}.tmp.{os.getpid()}"
@@ -335,7 +353,7 @@ def write_crash_report(output_model: str, rank: int,
         os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
             f.write(f"# crash report: rank {rank}, pid {os.getpid()}, "
-                    f"time {time.time():.3f}\n")
+                    f"time {time.time():.3f}, epoch {group_epoch()}\n")
             if exc is not None:
                 f.write("## exception\n")
                 f.write("".join(traceback.format_exception(
@@ -369,15 +387,61 @@ def _pid_alive(pid: int) -> bool:
 _TMP_RE = re.compile(r"\.tmp\.r(\d+)\.(\d+)$")
 
 
+def _stamped_epoch(path: str) -> int:
+    """The incarnation epoch a liveness artifact was stamped with: the
+    ``epoch`` key of a heartbeat JSON line, the ``epoch N`` field of a
+    crash-report header, or the newest parseable record's ``epoch`` of a
+    flight stream.  Files from before the epoch fence carry no stamp and
+    read as epoch 0 (always sweepable by a later incarnation)."""
+    import json
+    try:
+        with open(path, "rb") as f:
+            head = f.read(4096).decode("utf-8", errors="replace")
+    except OSError:
+        return 0
+    first = head.splitlines()[0] if head.splitlines() else ""
+    m = re.search(r"\bepoch (\d+)\b", first)
+    if first.startswith("# crash report:"):
+        return int(m.group(1)) if m else 0
+    best = 0
+    try:
+        with open(path, "rb") as f:
+            for line in f.read().decode("utf-8", errors="replace").splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    try:
+                        best = max(best, int(rec.get("epoch", 0) or 0))
+                    except (TypeError, ValueError):
+                        continue
+    except OSError:
+        return 0
+    return best
+
+
 def sweep_stale_tmp(output_model: str, crash_reports: bool = False,
-                    heartbeats: bool = False) -> List[str]:
+                    heartbeats: bool = False, *,
+                    current_epoch: Optional[int] = None,
+                    flight_base: str = "") -> List[str]:
     """Startup hygiene for crashed ranks: remove ``.tmp.r<rank>.<pid>``
     atomic-write leftovers whose writer pid is dead (a SIGKILLed rank's
     half-written tmp otherwise lives forever on a shared filesystem), and
     — when asked — orphan crash reports and heartbeat files from previous
     incarnations.  Live pids are never touched: a peer rank mid-write
     keeps its tmp.  Returns the removed paths; every removal is recorded
-    as a ``stale_sweep`` obs event so the cleanup is observable."""
+    as a ``stale_sweep`` obs event so the cleanup is observable.
+
+    ``current_epoch`` (keyword-only; the elastic supervisor's per-launch
+    incarnation counter) additionally sweeps heartbeat / crash-report /
+    flight-stream files whose stamped epoch is OLDER than it — a dead
+    incarnation's artifacts must never be mistaken for the live group's
+    (``flight_base`` names the ``obs_stream_path`` prefix to sweep).  The
+    default (``None``) keeps the historical pid/flag-only behavior."""
     from .obs.counters import counters
     base = os.path.basename(output_model)
     d = os.path.dirname(os.path.abspath(output_model))
@@ -396,7 +460,22 @@ def sweep_stale_tmp(output_model: str, crash_reports: bool = False,
         victims += [(p, "stale heartbeat") for p in
                     glob.glob(glob.escape(output_model)
                               + ".heartbeat.rank_*")]
+    if current_epoch is not None:
+        epoch_files = (
+            glob.glob(glob.escape(output_model) + ".heartbeat.rank_*")
+            + glob.glob(glob.escape(output_model) + ".crash.rank_*"))
+        if flight_base:
+            epoch_files += (glob.glob(glob.escape(flight_base) + ".rank_*"))
+        for p in epoch_files:
+            ep = _stamped_epoch(p)
+            if ep < int(current_epoch):
+                victims.append((p, f"dead epoch ({ep} < current "
+                                   f"{int(current_epoch)})"))
+    seen: set = set()
     for p, why in victims:
+        if p in seen:
+            continue
+        seen.add(p)
         try:
             os.unlink(p)
         except OSError:                # pragma: no cover - races/permissions
@@ -616,6 +695,33 @@ def data_fingerprint(binned, num_data: int) -> int:
     return crc
 
 
+ELASTIC_FP_STRIDE = 64
+
+
+def elastic_fingerprint_partial(binned, num_data: int, global_offset: int,
+                                stride: int = ELASTIC_FP_STRIDE) -> int:
+    """This rank's summand of the topology-independent GLOBAL dataset
+    fingerprint: ``sum over sampled global rows g of crc32(row) * (g+1),
+    mod 2**64``, sampling every ``stride``-th global row.  Addressed by
+    GLOBAL row index, the per-rank partials sum to the same value no
+    matter how the rows are partitioned — so a W-rank manifest's
+    fingerprint can be re-verified by a W'-rank group after an elastic
+    resume (the per-rank :func:`data_fingerprint` is partition-shaped and
+    cannot survive a reshard)."""
+    import numpy as np
+    if binned is None or num_data <= 0:
+        return 0
+    a = np.ascontiguousarray(binned)
+    total = 0
+    # first sampled GLOBAL row >= global_offset that is ≡ 0 (mod stride)
+    start = (-int(global_offset)) % int(stride)
+    for local in range(start, int(num_data), int(stride)):
+        g = int(global_offset) + local
+        total = (total + zlib.crc32(np.ascontiguousarray(a[local]).tobytes())
+                 * (g + 1)) % (1 << 64)
+    return total
+
+
 def _default_gather():
     from .parallel.sync import allgather_object
     return allgather_object
@@ -623,14 +729,24 @@ def _default_gather():
 
 def write_group_snapshot(output_model: str, iteration: int, model_str: str,
                          state: Dict[str, Any], *, rank: int, world: int,
-                         fingerprint: int, gather=None) -> None:
+                         fingerprint: int, gather=None,
+                         elastic_meta: Optional[Dict[str, Any]] = None
+                         ) -> None:
     """One rank's half of the coordinated snapshot protocol.
 
     Shard write (atomic, every rank) -> barrier (allgather of shard CRCs
     through the hardened collective ladder) -> manifest write (rank 0, the
     commit point).  A crash at ANY instant leaves either the previous
     committed set or the new one: shards without a manifest never existed.
-    """
+
+    ``elastic_meta`` (engine-provided, optional) rides the existing CRC
+    barrier and lands GLOBAL partition boundaries in the manifest —
+    ``partition_rows`` / ``valid_partition_rows`` / ``num_data_global`` /
+    ``global_fingerprint`` / ``num_features`` / ``num_class`` — which is
+    what lets :func:`find_latest_valid_elastic` load this set at a
+    DIFFERENT world size.  Keys: ``num_data``, ``valid_num_data`` (list),
+    ``fp_partial`` (:func:`elastic_fingerprint_partial` at this rank's
+    global row offset), ``num_features``, ``num_class``."""
     gather = gather or _default_gather()
     fi = faults_mod.get_faults()
     spath = shard_path(output_model, iteration, rank)
@@ -648,8 +764,11 @@ def write_group_snapshot(output_model: str, iteration: int, model_str: str,
             f"rank_crash_in_barrier fault: rank {rank} killed before the "
             f"iteration-{iteration} snapshot barrier")
     # barrier + CRC exchange: nobody commits until every shard is durable
-    infos = gather({"rank": rank, "crc": zlib.crc32(data),
-                    "fingerprint": int(fingerprint)})
+    info = {"rank": rank, "crc": zlib.crc32(data),
+            "fingerprint": int(fingerprint)}
+    if elastic_meta is not None:
+        info["elastic"] = dict(elastic_meta)
+    infos = gather(info)
     if rank != 0:
         return
     by_rank = {int(i["rank"]): i for i in infos}
@@ -661,6 +780,22 @@ def write_group_snapshot(output_model: str, iteration: int, model_str: str,
         "data_fingerprint": [int(by_rank[r]["fingerprint"])
                              for r in range(world)],
     }
+    metas = {r: by_rank[r].get("elastic") for r in range(world)
+             if r in by_rank}
+    if len(metas) == world and all(metas[r] for r in range(world)):
+        # every rank shipped partition metadata: commit the global
+        # boundaries the elastic resume path reassembles from
+        manifest["partition_rows"] = [int(metas[r]["num_data"])
+                                      for r in range(world)]
+        manifest["valid_partition_rows"] = [
+            [int(v) for v in metas[r].get("valid_num_data", [])]
+            for r in range(world)]
+        manifest["num_data_global"] = sum(manifest["partition_rows"])
+        manifest["global_fingerprint"] = (
+            sum(int(metas[r].get("fp_partial", 0)) for r in range(world))
+            % (1 << 64))
+        manifest["num_features"] = int(metas[0].get("num_features", 0))
+        manifest["num_class"] = int(metas[0].get("num_class", 1))
     mdata = encode("", manifest)
     mpath = manifest_path(output_model, iteration)
     if fi.enabled and fi.fire("torn_manifest", iteration):
@@ -698,11 +833,16 @@ def _local_valid_group_iters(output_model: str, rank: int, world: int,
             log.warning("Skipping snapshot set iter %d: %s", it, e)
             continue
         if int(manifest.get("process_count", -1)) != world:
+            old_world = int(manifest.get("process_count", 0) or 0)
             fatal = (f"checkpoint set at iteration {it} was written by "
                      f"{manifest.get('process_count')} process(es) but this "
                      f"job runs {world} — resuming across a topology change "
-                     "would silently diverge; restart from scratch or rerun "
-                     "with the original process count")
+                     "would silently diverge in strict mode; candidate set "
+                     f"{os.path.basename(manifest_path(output_model, it))} "
+                     f"(shards rank_0..rank_{max(0, old_world - 1)}) can "
+                     "only be accepted elastically: set elastic_resume=true "
+                     f"to reassemble it at {world} rank(s), or restart from "
+                     "scratch / rerun with the original process count")
             break
         if int(manifest["data_fingerprint"][rank]) != int(fingerprint):
             fatal = (f"checkpoint set at iteration {it}: rank {rank}'s "
@@ -782,3 +922,322 @@ def find_latest_valid_group(output_model: str, *, rank: int, world: int,
                     "rank(s) %s)", local_best, best, bad_ranks)
     _, state = load_snapshot(shard_path(output_model, best, rank))
     return best, shard_path(output_model, best, rank), state
+
+
+# --------------------------- elastic (topology-change) resume protocol
+
+def _offsets(parts: List[int]) -> List[int]:
+    out, acc = [], 0
+    for p in parts:
+        out.append(acc)
+        acc += int(p)
+    return out
+
+
+def _overlapping(parts: List[int], lo: int, hi: int) -> List[int]:
+    offs = _offsets(parts)
+    return [r for r in range(len(parts))
+            if offs[r] < hi and offs[r] + int(parts[r]) > lo]
+
+
+def _elastic_local_candidates(output_model: str, rank: int,
+                              lo: int, hi: int, new_total: int,
+                              valid_totals: List[int],
+                              valid_ranges: List[Tuple[int, int]]):
+    """Scan every committed artifact under the prefix newest-first from
+    THIS rank's view and return the candidates it could elastically load:
+    ``[(iteration, kind), ...]`` descending, kind ``"group"`` (a
+    committed W-rank set whose manifest carries partition boundaries) or
+    ``"plain"`` (a single-process ``.snapshot_iter_N`` treated as a
+    1-rank set — the 1→W direction).  A candidate is local-valid when its
+    global row totals match this job AND every old shard overlapping this
+    rank's new train/valid row ranges checks out (CRC vs manifest +
+    decode).  Mismatched candidates are SKIPPED (with a
+    ``checkpoint_skipped`` event), never fatal: elastic resume accepts
+    any topology it can reassemble and demotes past the ones it cannot."""
+    ok: List[Tuple[int, str]] = []
+    for it in sorted(list_snapshot_sets(output_model), reverse=True):
+        try:
+            manifest = load_manifest(output_model, it)
+        except CheckpointError as e:
+            _skip_event(it, manifest_path(output_model, it), str(e))
+            log.warning("Skipping snapshot set iter %d: %s", it, e)
+            continue
+        parts = manifest.get("partition_rows")
+        if not parts:
+            _skip_event(it, manifest_path(output_model, it),
+                        "pre-elastic manifest carries no partition "
+                        "boundaries")
+            log.warning("Skipping snapshot set iter %d for elastic resume: "
+                        "its manifest predates partition boundaries", it)
+            continue
+        vparts = manifest.get("valid_partition_rows") or []
+        old_world = len(parts)
+        old_valid_totals = [sum(int(vparts[r][v]) for r in range(old_world))
+                            for v in range(len(vparts[0]) if vparts
+                                           and vparts[0] is not None else 0)]
+        if int(manifest.get("num_data_global", -1)) != int(new_total) \
+                or old_valid_totals != [int(v) for v in valid_totals]:
+            _skip_event(it, manifest_path(output_model, it),
+                        f"global row totals mismatch (set: "
+                        f"{manifest.get('num_data_global')} train rows, "
+                        f"{old_valid_totals} valid; job: {new_total}, "
+                        f"{list(valid_totals)})")
+            log.warning("Skipping snapshot set iter %d for elastic resume: "
+                        "its global row totals do not match this job", it)
+            continue
+        # which old ranks this rank must read: union of the overlaps of
+        # its new train range and each of its new valid ranges
+        need = set(_overlapping([int(p) for p in parts], lo, hi))
+        for v, (vlo, vhi) in enumerate(valid_ranges):
+            need |= set(_overlapping(
+                [int(vparts[r][v]) for r in range(old_world)], vlo, vhi))
+        bad = None
+        for r in sorted(need):
+            spath = shard_path(output_model, it, r)
+            try:
+                with open(spath, "rb") as f:
+                    data = f.read()
+                want = int(manifest["shard_crc32"][r])
+                got = zlib.crc32(data)
+                if got != want:
+                    raise CheckpointError(
+                        f"shard CRC mismatch vs manifest (manifest "
+                        f"{want:08x}, file {got:08x})")
+                decode(data)
+            except (OSError, CheckpointError) as e:
+                bad = (spath, f"old rank {r}: {e}")
+                break
+        if bad is not None:
+            _skip_event(it, bad[0], bad[1])
+            log.warning("Snapshot set iter %d invalid for elastic resume "
+                        "on rank %d (%s); demoting to an older candidate",
+                        it, rank, bad[1])
+            continue
+        ok.append((it, "group"))
+    for it, path in reversed(list_snapshots(output_model)):
+        try:
+            _, state = load_snapshot(path)
+            bst = state["booster"]
+            import numpy as np
+            n = int(np.asarray(bst["scores"]).shape[1])
+            vns = [int(np.asarray(s).shape[1])
+                   for s in bst.get("valid_scores", [])]
+        except (CheckpointError, KeyError, IndexError) as e:
+            _skip_event(it, path, f"elastic scan: {e}")
+            log.warning("Skipping invalid snapshot %s: %s", path, e)
+            continue
+        if n != int(new_total) or vns != [int(v) for v in valid_totals]:
+            _skip_event(it, path,
+                        f"global row totals mismatch (snapshot: {n} train "
+                        f"rows, {vns} valid; job: {new_total}, "
+                        f"{list(valid_totals)})")
+            log.warning("Skipping snapshot %s for elastic resume: its row "
+                        "totals do not match this job", path)
+            continue
+        ok.append((it, "plain"))
+    ok.sort(key=lambda c: (c[0], c[1] == "group"), reverse=True)
+    return ok
+
+
+def _splice_rows(arrays: List[Any], parts: List[int], lo: int, hi: int,
+                 axis: int):
+    """Concatenate the ``[lo, hi)`` global-row window out of per-old-rank
+    row-partitioned arrays (``arrays[i]`` holds old rank i's partition of
+    ``parts[i]`` rows along ``axis``)."""
+    import numpy as np
+    offs = _offsets(parts)
+    pieces = []
+    for i, r in enumerate(_overlapping(parts, lo, hi)):
+        a = np.asarray(arrays[r])
+        s = max(lo - offs[r], 0)
+        e = min(hi, offs[r] + int(parts[r])) - offs[r]
+        pieces.append(a[:, s:e] if axis == 1 else a[s:e])
+    return np.concatenate(pieces, axis=axis)
+
+
+def _reassemble_elastic_state(shard_states: Dict[int, Dict[str, Any]],
+                              parts: List[int], vparts: List[List[int]],
+                              lo: int, hi: int,
+                              valid_ranges: List[Tuple[int, int]]
+                              ) -> Dict[str, Any]:
+    """Splice one new rank's checkpoint state out of the old group's
+    shards.  ``shard_states`` maps old rank -> that shard's outer state
+    dict (it must contain every old rank overlapping the new train/valid
+    ranges); row-partitioned state (score matrices, bagging weight/count
+    vectors, the bag-subset index) is re-cut at GLOBAL row boundaries,
+    replicated state (model list, iteration bookkeeping, RNG streams —
+    every rank of a deterministic group holds identical streams) comes
+    from the lowest overlapping shard, and the per-partition
+    ``data_fingerprint`` is cleared (the global fingerprint check is the
+    elastic replacement)."""
+    import numpy as np
+    train_ranks = _overlapping(parts, lo, hi)
+    base = shard_states[train_ranks[0]]
+    bs = {r: shard_states[r]["booster"] for r in shard_states}
+    b0 = bs[train_ranks[0]]
+    offs = _offsets(parts)
+
+    def train_cut(key, axis):
+        return _splice_rows([bs.get(r, {}).get(key) if r in bs else None
+                             for r in range(len(parts))],
+                            [int(p) for p in parts], lo, hi, axis)
+
+    booster = {
+        "data_fingerprint": None,
+        "kind": b0["kind"],
+        "models": list(b0["models"]),
+        "iter_": b0["iter_"],
+        "num_init_iteration": b0["num_init_iteration"],
+        "boost_from_average_": b0["boost_from_average_"],
+        "best_iteration": b0["best_iteration"],
+        "scores": train_cut("scores", axis=1),
+        "bag_rng": b0["bag_rng"],
+        "feat_rng": b0["feat_rng"],
+        "bagging_on": b0["bagging_on"],
+        "bag_weight": train_cut("bag_weight", axis=0),
+        "bag_cnt": train_cut("bag_cnt", axis=0),
+        "learning_rate": b0["learning_rate"],
+    }
+    vscores = []
+    for v, (vlo, vhi) in enumerate(valid_ranges):
+        vp = [int(vparts[r][v]) for r in range(len(parts))]
+        vscores.append(_splice_rows(
+            [bs.get(r, {}).get("valid_scores", [None] * (v + 1))[v]
+             if r in bs else None for r in range(len(parts))],
+            vp, vlo, vhi, axis=1))
+    booster["valid_scores"] = vscores
+    if any(bs[r].get("subset") is not None for r in train_ranks):
+        idx_parts, w_parts = [], []
+        for r in train_ranks:
+            sub = bs[r].get("subset")
+            if sub is None:
+                continue
+            g = np.asarray(sub["idx"], np.int64) + offs[r]
+            keep = (g >= lo) & (g < hi)
+            idx_parts.append(g[keep] - lo)
+            w_parts.append(np.asarray(sub["w"])[keep])
+        booster["subset"] = {
+            "idx": np.concatenate(idx_parts) if idx_parts
+            else np.zeros(0, np.int64),
+            "w": np.concatenate(w_parts) if w_parts
+            else np.zeros(0, np.float32)}
+    else:
+        booster["subset"] = None
+    return {
+        "version": base["version"],
+        "iteration": base["iteration"],
+        "booster": booster,
+        "best_iteration": base["best_iteration"],
+        "best_score": copy.deepcopy(base["best_score"]),
+        "evals_result": copy.deepcopy(base.get("evals_result")),
+        "callback_states": copy.deepcopy(base.get("callback_states")),
+    }
+
+
+def find_latest_valid_elastic(output_model: str, *, rank: int, world: int,
+                              num_data: int, valid_num_data=(),
+                              fingerprint_partial_fn=None, gather=None,
+                              only_iteration: Optional[int] = None):
+    """The ELASTIC resume barrier (``elastic_resume=true``): agree on the
+    newest committed artifact — a W-rank snapshot set at ANY W, or a
+    plain single-process snapshot — that every rank of THIS W'-rank group
+    can reassemble its new partition from, then splice the global state
+    at the new row boundaries.  W→1 and 1→W are first-class: a plain
+    snapshot is a 1-rank set, and a new world of 1 reads every old shard.
+
+    Three rendezvous ride the hardened collective ladder (all of them
+    single-process no-ops): the partition exchange (each rank's new
+    train/valid row counts -> global boundaries), the candidate
+    agreement, and the global-fingerprint audit
+    (:func:`elastic_fingerprint_partial` partials summed over the NEW
+    partition must reproduce the manifest's ``global_fingerprint`` —
+    same rows, any cut).  Returns ``(iteration, path, state)`` with the
+    state's per-partition fingerprint cleared, or None when nothing is
+    elastically loadable."""
+    gather = gather or _default_gather()
+    sweep_stale_tmp(output_model)
+    me = {"rank": int(rank), "num_data": int(num_data),
+          "valid": [int(v) for v in valid_num_data]}
+    parts_view = sorted(gather(me), key=lambda p: int(p["rank"]))
+    new_parts = [int(p["num_data"]) for p in parts_view]
+    new_total = sum(new_parts)
+    offs = _offsets(new_parts)
+    lo, hi = offs[rank], offs[rank] + int(num_data)
+    valid_totals = [sum(int(p["valid"][v]) for p in parts_view)
+                    for v in range(len(me["valid"]))]
+    valid_ranges: List[Tuple[int, int]] = []
+    for v in range(len(me["valid"])):
+        voffs = _offsets([int(p["valid"][v]) for p in parts_view])
+        valid_ranges.append((voffs[rank], voffs[rank] + int(me["valid"][v])))
+    ok = _elastic_local_candidates(output_model, rank, lo, hi, new_total,
+                                   valid_totals, valid_ranges)
+    views = gather({"rank": rank, "ok": [list(c) for c in ok]})
+    cand_sets = [set((int(i2), str(k)) for i2, k in v["ok"]) for v in views]
+    agreed = set.intersection(*cand_sets) if cand_sets else set()
+    if only_iteration is not None:
+        agreed = {c for c in agreed if c[0] == int(only_iteration)}
+        if not agreed:
+            raise CheckpointError(
+                f"snapshot set at iteration {only_iteration} of "
+                f"{output_model} is not elastically loadable on every rank")
+    if not agreed:
+        return None
+    best_it, best_kind = max(agreed, key=lambda c: (c[0], c[1] == "group"))
+    local_best = ok[0][0] if ok else None
+    if local_best is not None and best_it != local_best:
+        bad_ranks = [int(v["rank"]) for i3, v in enumerate(views)
+                     if not any(c[0] == local_best for c in v["ok"])]
+        _skip_event(local_best,
+                    manifest_path(output_model, local_best),
+                    f"demoted to iteration {best_it}: rank(s) {bad_ranks} "
+                    "hold no elastically loadable candidate")
+        log.warning("Elastic candidate iter %d demoted to iter %d (not "
+                    "loadable on rank(s) %s)", local_best, best_it,
+                    bad_ranks)
+    if best_kind == "plain":
+        path = snapshot_path(output_model, best_it)
+        _, state = load_snapshot(path)
+        import numpy as np
+        parts = [int(np.asarray(state["booster"]["scores"]).shape[1])]
+        vparts = [[int(np.asarray(s).shape[1])
+                   for s in state["booster"].get("valid_scores", [])]]
+        shard_states = {0: state}
+        gfp = None
+    else:
+        path = manifest_path(output_model, best_it)
+        manifest = load_manifest(output_model, best_it)
+        parts = [int(p) for p in manifest["partition_rows"]]
+        vparts = manifest.get("valid_partition_rows") or \
+            [[] for _ in parts]
+        need = set(_overlapping(parts, lo, hi))
+        for v, (vlo, vhi) in enumerate(valid_ranges):
+            need |= set(_overlapping(
+                [int(vparts[r][v]) for r in range(len(parts))], vlo, vhi))
+        shard_states = {}
+        for r in sorted(need):
+            _, shard_states[r] = load_snapshot(
+                shard_path(output_model, best_it, r))
+        gfp = manifest.get("global_fingerprint")
+    state = _reassemble_elastic_state(shard_states, parts, vparts, lo, hi,
+                                      valid_ranges)
+    if gfp is not None and fingerprint_partial_fn is not None:
+        fps = gather({"rank": rank,
+                      "fp": int(fingerprint_partial_fn(lo))})
+        total_fp = sum(int(p["fp"]) for p in fps) % (1 << 64)
+        if total_fp != int(gfp):
+            raise CheckpointError(
+                f"elastic resume at iteration {best_it}: the group's "
+                f"global dataset fingerprint ({total_fp}) does not match "
+                f"the manifest's ({int(gfp)}) — the rows this {world}-rank "
+                "group holds are not the rows the checkpoint was taken "
+                "over (re-partitioned or re-binned data?)")
+    from .obs.counters import counters
+    counters.event("elastic_resume", iteration=int(best_it),
+                   kind=best_kind, old_world=len(parts), new_world=world,
+                   rank=rank, rows=[lo, hi])
+    log.info("Elastic resume: reassembled iteration %d from a %d-rank %s "
+             "at world=%d (rank %d rows [%d, %d))", best_it, len(parts),
+             "snapshot" if best_kind == "plain" else "set", world, rank,
+             lo, hi)
+    return best_it, path, state

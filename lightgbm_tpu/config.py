@@ -9,6 +9,7 @@ rejection, and the cross-field conflict checks (``src/io/config.cpp:188-240``)
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from .utils import log
@@ -308,6 +309,31 @@ class Config:
                                      # armed); snapshots land at
                                      # output_model like snapshot_freq ones
                                      # and resume with snapshot_resume.
+    elastic_resume: bool = False     # elastic groups: accept a committed
+                                     # snapshot set written by a DIFFERENT
+                                     # process count (any W -> this job's
+                                     # W'): each rank reassembles its new
+                                     # row partition from the old shards
+                                     # at global row boundaries and the
+                                     # group re-verifies the manifest's
+                                     # global dataset fingerprint.  Also
+                                     # arms the supervisor's degraded-world
+                                     # relaunch.  Default false: strict
+                                     # topology matching (a mismatch stays
+                                     # fatal)
+    elastic_min_ranks: int = 1       # floor for the supervisor's
+                                     # degraded-world relaunch: the group
+                                     # is never shrunk below this many
+                                     # ranks (budget exhaustion applies
+                                     # instead)
+    world_shrink_after: int = 2      # consecutive STARTUP failures (a rank
+                                     # dying before its first heartbeat of
+                                     # an incarnation) after which the
+                                     # supervisor declares the rank's host
+                                     # lost and relaunches the group one
+                                     # rank smaller through the elastic
+                                     # resume path (requires
+                                     # elastic_resume=true)
 
     # serving (docs/SERVING.md): the high-QPS batched prediction engine
     latency_budget_ms: float = 2.0   # serving microbatcher coalescing
@@ -631,8 +657,12 @@ def check_param_conflicts(cfg: Config) -> None:
             world = max(1, cfg.num_machines)
             for e in entries:
                 # a rank qualifier naming a rank the job does not run
-                # would silently inject nothing — reject it here
-                if e.rank is not None and e.rank >= world:
+                # would silently inject nothing — reject it here.  Skipped
+                # under an elastic relaunch (LGBM_TPU_WORLD set): the spec
+                # was written for the LAUNCH topology, and a shrunk world
+                # legitimately no longer runs the evicted rank
+                if e.rank is not None and e.rank >= world \
+                        and "LGBM_TPU_WORLD" not in os.environ:
                     log.fatal("fault_inject: rank=%d targets a rank this "
                               "job does not run (num_machines=%d)",
                               e.rank, world)
@@ -685,6 +715,12 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.restart_backoff < 0:
         log.fatal("restart_backoff must be >= 0 seconds; got %r",
                   cfg.restart_backoff)
+    if cfg.elastic_min_ranks < 1:
+        log.fatal("elastic_min_ranks must be >= 1; got %d",
+                  cfg.elastic_min_ranks)
+    if cfg.world_shrink_after < 1:
+        log.fatal("world_shrink_after must be >= 1 consecutive startup "
+                  "failures; got %d", cfg.world_shrink_after)
     if cfg.pallas_hist_impl == "nibble":
         # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
         # axis and tiles (feat_tile * 16) output lanes — reject shapes it

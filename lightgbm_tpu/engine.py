@@ -74,6 +74,26 @@ def train(params: Dict[str, Any], train_set: Dataset,
     prev_faults = faults_mod.get_faults()
     if fault_spec:
         faults_mod.install(fault_spec)
+    # host_lost fault, startup leg: in a RELAUNCHED incarnation (the
+    # supervisor stamps its attempt counter into child env) the lost
+    # rank dies again BEFORE its first heartbeat — the repeatable
+    # startup failure the supervisor's world_shrink_after counter is
+    # defined over.  targets() (not fire()) so the @K pin stays armed
+    # for the mid-run death of attempt 0.
+    try:
+        _sup_attempt = int(
+            os.environ.get("LGBM_TPU_SUPERVISOR_ATTEMPT", "0") or 0)
+    except ValueError:
+        _sup_attempt = 0
+    if _sup_attempt > 0:
+        _fi = faults_mod.get_faults()
+        if _fi.enabled and _fi.targets("host_lost",
+                                       faults_mod.current_rank()):
+            log.warning("host_lost fault: rank %d's host never comes "
+                        "back — dying at startup of attempt %d (before "
+                        "the first heartbeat)",
+                        faults_mod.current_rank(), _sup_attempt)
+            os._exit(70)
     # host-object collective budget (parallel/sync.py recovery ladder)
     from .parallel import sync as sync_mod
     if params.get("collective_timeout") or params.get("collective_retries") \
@@ -83,6 +103,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
             if params.get("collective_timeout") else None,
             retries=int(params["collective_retries"])
             if params.get("collective_retries") is not None else None)
+    # elastic relaunch override: after a degraded-world shrink the
+    # supervisor stamps the CURRENT world size into child env; the
+    # user-level num_machines still describes the LAUNCH topology, so
+    # reduce it here (a world of 1 then skips distributed bring-up — and
+    # its dead-peer rendezvous — entirely)
+    _env_world = os.environ.get("LGBM_TPU_WORLD", "")
+    if _env_world.strip():
+        try:
+            _w = int(_env_world)
+        except ValueError:
+            _w = 0
+        if _w >= 1 and _w != int(params.get("num_machines", 1) or 1):
+            log.info("LGBM_TPU_WORLD=%d overrides num_machines=%s "
+                     "(elastic relaunch at a shrunk world)", _w,
+                     params.get("num_machines", 1))
+            params["num_machines"] = _w
     if int(params.get("num_machines", 1)) > 1:
         # multi-host bring-up from config (application.cpp:190-224 analogue)
         from .config import config_from_params
@@ -173,6 +209,36 @@ def train(params: Dict[str, Any], train_set: Dataset,
         obs_metrics.start_exporter(metrics_port + rank)
         exporter_armed = True
     ckpt_callbacks = cbs_before + cbs_after   # stable capture/restore order
+    # elastic groups (docs/ROBUSTNESS.md): opt-in acceptance of committed
+    # sets written at a DIFFERENT process count
+    elastic = str(params.get("elastic_resume", "")).strip().lower() \
+        in ("true", "1", "yes", "on", "+")
+    _elastic_cache: List[Optional[Dict[str, Any]]] = [None]
+
+    def _elastic_meta() -> Dict[str, Any]:
+        """Partition metadata each shard ships through the existing commit
+        barrier so the manifest carries GLOBAL row boundaries.  Cached:
+        the partition cannot change mid-training, so the offset exchange
+        is one extra allgather per TRAINING, not per snapshot."""
+        if _elastic_cache[0] is None:
+            ts = booster.inner.train_set
+            n_local = int(ts.num_data)
+            views = sorted(
+                sync_mod.allgather_object({"rank": rank,
+                                           "num_data": n_local}),
+                key=lambda v: int(v["rank"]))
+            off = sum(int(v["num_data"]) for v in views
+                      if int(v["rank"]) < rank)
+            _elastic_cache[0] = {
+                "num_data": n_local,
+                "valid_num_data": [int(vs.data.num_data)
+                                   for vs in booster.inner.valid_sets],
+                "fp_partial": checkpoint_mod.elastic_fingerprint_partial(
+                    np.asarray(ts.binned), n_local, off),
+                "num_features": int(np.asarray(ts.binned).shape[1]),
+                "num_class": int(booster.inner.num_class),
+            }
+        return _elastic_cache[0]
 
     def _write_checkpoint(iteration: int) -> None:
         """One atomic snapshot at an iteration boundary: the single-file
@@ -191,7 +257,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             snapshot_out, iteration,
             booster.model_to_string(-1) if rank == 0 else "", state,
             rank=rank, world=world,
-            fingerprint=booster.inner.data_fingerprint())
+            fingerprint=booster.inner.data_fingerprint(),
+            elastic_meta=_elastic_meta())
         if snapshot_keep > 0 and rank == 0:
             # only after the manifest commit, and only on rank 0: the
             # barrier guarantees every shard of the new set is durable, so
@@ -209,7 +276,28 @@ def train(params: Dict[str, Any], train_set: Dataset,
             resume = True
     start_iter = 0
     if resume:
-        if single_process:
+        if elastic:
+            # the ELASTIC resume barrier (docs/ROBUSTNESS.md "Elastic
+            # groups"): agree on the newest committed artifact at ANY
+            # topology this group can reassemble — a W-rank set spliced
+            # at global row boundaries, or a plain snapshot as a 1-rank
+            # set (W->1 and 1->W are first-class)
+            ts = booster.inner.train_set
+
+            def _fp_partial(global_offset: int) -> int:
+                return checkpoint_mod.elastic_fingerprint_partial(
+                    np.asarray(ts.binned), int(ts.num_data),
+                    int(global_offset))
+
+            found = checkpoint_mod.find_latest_valid_elastic(
+                snapshot_out, rank=rank, world=world,
+                num_data=int(ts.num_data),
+                valid_num_data=[int(vs.data.num_data)
+                                for vs in booster.inner.valid_sets],
+                fingerprint_partial_fn=_fp_partial,
+                only_iteration=(checkpoint_mod.iteration_from_path(resume)
+                                if isinstance(resume, str) else None))
+        elif single_process:
             if isinstance(resume, str):    # explicit checkpoint file
                 _, state = checkpoint_mod.load_snapshot(resume)
                 found = (int(state["iteration"]), resume, state)
@@ -278,6 +366,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
             log.warning("rank_crash fault: rank %d dying hard at "
                         "iteration %d (os._exit, no checkpoint, no "
                         "goodbye)", rank, iteration)
+            os._exit(70)
+        if fi.enabled and fi.fire("host_lost", iteration):
+            log.warning("host_lost fault: rank %d dying hard at iteration "
+                        "%d — and its host will NOT come back (every "
+                        "relaunched incarnation dies again at startup)",
+                        rank, iteration)
             os._exit(70)
         if fi.enabled and fi.fire("rank_hang", iteration):
             log.warning("rank_hang fault: rank %d wedging at iteration %d "

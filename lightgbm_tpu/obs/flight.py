@@ -89,8 +89,9 @@ class FlightRecorder:
     def record(self, kind: str, **fields) -> None:
         """Append one event line.  Unsynced (liveness, not durability —
         the heartbeat rule); a full disk must never kill training."""
+        from ..checkpoint import group_epoch
         rec = {"t": round(time.time(), 3), "rank": self.rank,
-               "event": str(kind)}
+               "event": str(kind), "epoch": group_epoch()}
         rec.update(fields)
         line = json.dumps(rec, default=str) + "\n"
         with self._lock:

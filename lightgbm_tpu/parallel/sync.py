@@ -20,7 +20,16 @@ every host-object collective here is wrapped in the same recovery ladder:
   silent;
 * **fault injection** — the ``collective_fail`` / ``collective_corrupt``
   points (:mod:`lightgbm_tpu.utils.faults`) exercise the whole ladder on
-  CPU in tier-1.
+  CPU in tier-1;
+* **incarnation epoch fence** — every payload header carries the group
+  epoch the sender was launched under (``LGBM_TPU_GROUP_EPOCH``, minted
+  per (re)launch by the supervisor).  A frame from a PREVIOUS incarnation
+  — a process that survived a teardown and tries to rejoin after the
+  group relaunched, possibly at a different world size — raises
+  :class:`StaleEpochError` naming both epochs.  The fence is terminal:
+  a stale peer does not become current by retrying, so the retry ladder
+  passes it straight through.  The ``stale_rejoin`` fault point replays
+  exactly this on CPU at world=1 (zero hangs).
 
 ``broadcast_object`` is a real rank-0 length-then-payload broadcast: only
 process 0 pickles and ships its object (it used to run a full allgather
@@ -62,6 +71,58 @@ _BACKOFF = 0.25     # seconds; doubles per retry
 
 class CollectiveError(RuntimeError):
     """A host-object collective failed after exhausting its retries."""
+
+
+class StaleEpochError(CollectiveError):
+    """A collective frame arrived from a DEAD incarnation of the group.
+
+    Carries both sides of the fence: ``frame_epoch`` (what the stale
+    sender was launched under) and ``group_epoch`` (what this process was
+    launched under).  Terminal by design — :func:`_retrying` never
+    re-attempts it, because a process from a previous incarnation cannot
+    become current by waiting; it must be swept."""
+
+    def __init__(self, msg: str, *, frame_epoch: int, group_epoch: int):
+        super().__init__(msg)
+        self.frame_epoch = int(frame_epoch)
+        self.group_epoch = int(group_epoch)
+
+
+def _group_epoch() -> int:
+    # function-local import: checkpoint.py reaches back into this module
+    # (function-locally) for the resume barriers
+    from ..checkpoint import group_epoch
+    return group_epoch()
+
+
+def _check_frame_epoch(frame_epoch: int, what: str, peer: Any = "?") -> None:
+    """The incarnation fence itself: reject any frame whose stamped epoch
+    differs from ours, with a structured event + error naming BOTH epochs
+    and the offending process."""
+    mine = _group_epoch()
+    if int(frame_epoch) == mine:
+        return
+    from ..obs.counters import counters
+    counters.event("stale_epoch_rejected", op=what, peer=str(peer),
+                   frame_epoch=int(frame_epoch), group_epoch=mine)
+    log.warning("%s: rejected frame from process %s at incarnation epoch "
+                "%d (this group is epoch %d)", what, peer,
+                int(frame_epoch), mine)
+    raise StaleEpochError(
+        f"{what}: frame from process {peer} carries incarnation epoch "
+        f"{int(frame_epoch)} but this group is epoch {mine} — a process "
+        "from a dead incarnation tried to rejoin; terminate it (it will "
+        "not become current by retrying)",
+        frame_epoch=int(frame_epoch), group_epoch=mine)
+
+
+def _maybe_stale_rejoin(what: str) -> None:
+    """``stale_rejoin`` fault point: simulate one frame from the previous
+    incarnation arriving at this collective (fires BEFORE the world==1
+    short-circuit so the fence is tier-1-testable with no peers)."""
+    fi = faults_mod.get_faults()
+    if fi.enabled and fi.fire("stale_rejoin"):
+        _check_frame_epoch(_group_epoch() - 1, what, peer="injected-stale")
 
 
 def configure(timeout: Optional[float] = None,
@@ -174,6 +235,10 @@ def _retrying(what: str, attempt_fn: Callable[[], Any]) -> Any:
     for attempt in range(_RETRIES + 1):
         try:
             return attempt_fn()
+        except StaleEpochError:
+            # the epoch fence is terminal: a stale incarnation cannot
+            # become current by retrying — surface it immediately
+            raise
         except Exception as e:
             last = e
             if attempt == _RETRIES:
@@ -219,15 +284,17 @@ def allgather_object(obj: Any) -> List[Any]:
 
     def attempt() -> List[Any]:
         _maybe_inject("allgather_object")
+        _maybe_stale_rejoin("allgather_object")
         if process_count() == 1:
             return [obj]
         from jax.experimental import multihost_utils
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-        header = np.asarray([len(payload), zlib.crc32(payload)], np.int64)
+        header = np.asarray([len(payload), zlib.crc32(payload),
+                             _group_epoch()], np.int64)
 
         def gather() -> List[Any]:
             headers = np.asarray(multihost_utils.process_allgather(
-                header)).reshape(-1, 2)
+                header)).reshape(-1, 3)
             lens = headers[:, 0]
             buf = np.zeros(int(lens.max()), np.uint8)
             buf[:len(payload)] = payload
@@ -235,6 +302,8 @@ def allgather_object(obj: Any) -> List[Any]:
                 multihost_utils.process_allgather(buf)))
             out = []
             for i in range(len(lens)):
+                _check_frame_epoch(int(headers[i, 2]), "allgather_object",
+                                   peer=i)
                 blob = gathered[i, :int(lens[i])]
                 crc = zlib.crc32(np.ascontiguousarray(blob))
                 # compare in uint32 space: the gloo CPU transport returns
@@ -267,6 +336,7 @@ def broadcast_object(obj: Any = None) -> Any:
 
     def attempt() -> Any:
         _maybe_inject("broadcast_object")
+        _maybe_stale_rejoin("broadcast_object")
         if process_count() == 1:
             return obj
         import jax
@@ -275,10 +345,12 @@ def broadcast_object(obj: Any = None) -> Any:
         payload = (np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
                    if is_root else np.zeros(0, np.uint8))
         header = np.asarray(
-            [len(payload), zlib.crc32(payload) if is_root else 0], np.int64)
+            [len(payload), zlib.crc32(payload) if is_root else 0,
+             _group_epoch()], np.int64)
 
         def bcast() -> Any:
             hdr = np.asarray(multihost_utils.broadcast_one_to_all(header))
+            _check_frame_epoch(int(hdr[2]), "broadcast_object", peer=0)
             # uint32-space compare: gloo sign-truncates int64 headers
             n, want = int(hdr[0]), int(hdr[1]) & 0xFFFFFFFF
             buf = payload if is_root else np.zeros(n, np.uint8)

@@ -142,7 +142,12 @@ class Supervisor:
                  obs_stream: str = "",
                  straggler_factor: float = 4.0,
                  straggler_interval: float = 1.0,
-                 metrics_port: int = 0):
+                 metrics_port: int = 0,
+                 elastic_resume: bool = False,
+                 elastic_min_ranks: int = 1,
+                 world_shrink_after: int = 2,
+                 machine_list_file: str = "",
+                 hbm_budget: int = 0):
         self.argv = list(argv)
         self.output_model = str(output_model)
         self.world = max(1, int(world))
@@ -178,6 +183,17 @@ class Supervisor:
         self._last_restart_unix = 0.0
         self._last_straggler_check = 0.0
         self._stragglers_flagged: set = set()
+        # elastic groups (docs/ROBUSTNESS.md "Elastic groups"): a rank
+        # whose every relaunch dies BEFORE its first heartbeat is a lost
+        # host — after world_shrink_after consecutive startup failures the
+        # group relaunches one rank smaller through the elastic-resume
+        # path (never below elastic_min_ranks)
+        self.elastic_resume = bool(elastic_resume)
+        self.elastic_min_ranks = max(1, int(elastic_min_ranks))
+        self.world_shrink_after = max(1, int(world_shrink_after))
+        self.machine_list_file = str(machine_list_file or "")
+        self.hbm_budget = int(hbm_budget or 0)
+        self._startup_failures: Dict[int, int] = {}
         metrics_mod.register_source(self._metrics_samples)
 
     def _metrics_samples(self) -> list:
@@ -193,6 +209,11 @@ class Supervisor:
              "gauge"),
             ("supervisor_restarts", {}, float(self.attempt), "counter"),
             ("supervisor_world", {}, float(self.world), "gauge"),
+            # the elastic shrink signal: supervisor_world is the CONFIGURED
+            # world of the incarnation being scraped; world_size tracks the
+            # same value but is the documented, stable name a dashboard
+            # alerts on — a drop in one scrape IS a shrink
+            ("world_size", {}, float(self.world), "gauge"),
         ]
         for r in range(self.world):
             hb = checkpoint_mod.read_heartbeat(
@@ -244,6 +265,26 @@ class Supervisor:
             reason, rank, detail = verdict
             self._teardown()
             self._collect_crash_reports()
+            # startup-failure bookkeeping for the elastic shrink trigger:
+            # _launch sweeps heartbeats per incarnation, so no stamp for
+            # the failed rank means it died BEFORE its first iteration
+            # boundary — the repeatable shape of a lost host.  A rank that
+            # got as far as beating resets its counter.
+            hb = checkpoint_mod.read_heartbeat(
+                checkpoint_mod.heartbeat_path(self.output_model, rank))
+            if hb is None:
+                self._startup_failures[rank] = \
+                    self._startup_failures.get(rank, 0) + 1
+            else:
+                self._startup_failures.pop(rank, None)
+            if (self.elastic_resume
+                    and self._startup_failures.get(rank, 0)
+                    >= self.world_shrink_after
+                    and self.world - 1 >= self.elastic_min_ranks):
+                rc = self._shrink(rank, reason, detail)
+                if rc is not None:
+                    return rc
+                continue
             it = checkpoint_mod.latest_committed_iteration(self.output_model)
             if it is not None and (self._progress_mark is None
                                    or it > self._progress_mark):
@@ -285,11 +326,81 @@ class Supervisor:
                 time.sleep(delay)
             self._launch()
 
+    def _shrink(self, rank: int, reason: str, detail: str) -> Optional[int]:
+        """Degraded-world relaunch: evict ``rank`` (its host is not coming
+        back), pre-flight the mesh plan for the smaller device set, and
+        relaunch the group at ``world - 1`` through the elastic-resume
+        path.  Returns None on success (supervision continues) or the
+        process exit code when the shrunk world cannot be planned."""
+        counters.event("rank_evicted", rank=rank, reason=reason,
+                       detail=detail, world=self.world,
+                       startup_failures=self._startup_failures.get(rank, 0))
+        log.warning("Supervisor: rank %d failed at startup %d time(s) in a "
+                    "row (%s, %s) — declaring its host lost and shrinking "
+                    "the group", rank, self._startup_failures.get(rank, 0),
+                    reason, detail)
+        new_world = self.world - 1
+        # the PR 10 pre-flight, re-run for the SHRUNK device set: the
+        # smaller group re-shards or fails here, before any compile.
+        # capacity is only enforceable when the operator gave a budget —
+        # plan_mesh with capacity=None picks a layout but cannot refuse.
+        it = checkpoint_mod.latest_committed_iteration(self.output_model)
+        manifest = None
+        if it is not None:
+            try:
+                manifest = checkpoint_mod.load_manifest(self.output_model,
+                                                        it)
+            except checkpoint_mod.CheckpointError:
+                manifest = None
+        if manifest and manifest.get("num_data_global"):
+            from .parallel.mesh import MeshPlanError, plan_mesh
+            try:
+                plan_mesh(new_world, int(manifest["num_data_global"]),
+                          max(1, int(manifest.get("num_features", 1) or 1)),
+                          num_class=max(1, int(manifest.get("num_class", 1)
+                                               or 1)),
+                          capacity=(self.hbm_budget
+                                    if self.hbm_budget > 0 else None))
+            except MeshPlanError as e:
+                counters.event("mesh_plan_failed", world=new_world,
+                               evicted_rank=rank, error=str(e))
+                log.warning("Supervisor: cannot shrink to %d rank(s) — "
+                            "mesh pre-flight refused the layout: %s",
+                            new_world, e)
+                return 1
+        # drop the evicted rank's machine-list entry so the smaller
+        # group's rendezvous never waits on the dead host
+        if self.machine_list_file \
+                and os.path.exists(self.machine_list_file):
+            from .parallel import mesh
+            machines = mesh.parse_machine_list(self.machine_list_file)
+            if rank < len(machines):
+                del machines[rank]
+                mesh.write_machine_list(self.machine_list_file, machines)
+        self.world = new_world
+        self.attempt += 1
+        self._startup_failures = {}
+        self._restarts_since_progress = 0
+        self._last_restart_unix = time.time()
+        counters.gauge("world_size", self.world)
+        counters.event("world_resize", world=self.world, evicted_rank=rank,
+                       attempt=self.attempt, resume_iteration=it)
+        log.warning("Supervisor: relaunching at world=%d (attempt %d) via "
+                    "elastic resume from committed iteration %s",
+                    self.world, self.attempt, it)
+        self._launch()
+        return None
+
     def _launch(self) -> None:
         # a fresh incarnation must not inherit the previous one's liveness
-        # artifacts: dead-pid tmps and old heartbeat stamps are swept
-        # (crash reports stay until read by _collect_crash_reports)
-        checkpoint_mod.sweep_stale_tmp(self.output_model, heartbeats=True)
+        # artifacts: dead-pid tmps and old heartbeat stamps are swept,
+        # along with heartbeat/crash/flight files stamped with DEAD
+        # incarnation epochs (crash reports of the incarnation that just
+        # failed stay until read by _collect_crash_reports)
+        checkpoint_mod.sweep_stale_tmp(
+            self.output_model, heartbeats=True,
+            current_epoch=self.attempt,
+            flight_base=self.obs_stream or "")
         if self.prelaunch is not None:
             self.prelaunch(self)
         self._ranks = []
@@ -298,6 +409,11 @@ class Supervisor:
             env.update(self.env)
             env["LGBM_TPU_RANK"] = str(r)
             env[ATTEMPT_ENV] = str(self.attempt)
+            # the incarnation epoch fence (parallel/sync.py) + the elastic
+            # world override (engine.train): children of THIS incarnation
+            # are distinguishable from any stale survivor's artifacts
+            env[checkpoint_mod.GROUP_EPOCH_ENV] = str(self.attempt)
+            env["LGBM_TPU_WORLD"] = str(self.world)
             logf = open(f"{self.output_model}.rank_{r}.log", "ab")
             try:
                 proc = subprocess.Popen(self.argv, env=env, stdout=logf,
@@ -464,10 +580,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         collective_retries=cfg.collective_retries, prelaunch=prelaunch,
         obs_stream=cfg.obs_stream_path,
         straggler_factor=cfg.straggler_factor,
-        metrics_port=cfg.metrics_port)
+        metrics_port=cfg.metrics_port,
+        elastic_resume=cfg.elastic_resume,
+        elastic_min_ranks=cfg.elastic_min_ranks,
+        world_shrink_after=cfg.world_shrink_after,
+        machine_list_file=cfg.machine_list_file,
+        hbm_budget=cfg.hbm_budget)
     rc = sup.run()
     for name in ("rank_dead", "rank_hang", "group_restart",
-                 "restart_budget_exhausted", "rank_straggler"):
+                 "restart_budget_exhausted", "rank_straggler",
+                 "rank_evicted", "world_resize", "mesh_plan_failed"):
         for e in counters.events(name):
             log.info("supervisor event: %s", e)
     return rc

@@ -60,6 +60,18 @@ fails fast instead of silently injecting nothing):
 ``slow_heartbeat``   heartbeat writes silently never land (stalled NFS
                      stand-in): the rank is alive and progressing but
                      looks dead to file-based liveness
+``host_lost``        a permanently lost host: the rank dies hard at an
+                     iteration boundary AND — in every relaunched
+                     incarnation — again at startup, before its first
+                     heartbeat, so the supervisor's consecutive
+                     startup-failure counter (``world_shrink_after``)
+                     sees a rank that never comes back (the elastic
+                     world-shrink trigger)
+``stale_rejoin``     a process from a PREVIOUS incarnation epoch sends one
+                     frame into the new group's collective: the epoch
+                     fence (``parallel/sync.py``) must reject it with a
+                     structured ``StaleEpochError`` naming both epochs —
+                     never retry it, never hang on it
 ===================  ========================================================
 
 Mirrors the :mod:`lightgbm_tpu.obs.trace` singleton discipline: when no
@@ -76,7 +88,8 @@ from typing import List, Optional
 KNOWN_POINTS = ("torn_checkpoint", "nan_grad", "inf_hess", "collective_fail",
                 "collective_corrupt", "hist_fail", "preempt",
                 "torn_shard_rank", "torn_manifest", "rank_crash_in_barrier",
-                "rank_crash", "rank_hang", "slow_heartbeat")
+                "rank_crash", "rank_hang", "slow_heartbeat", "host_lost",
+                "stale_rejoin")
 
 
 def current_rank() -> int:
@@ -200,6 +213,17 @@ class FaultPlan:
         with self._lock:
             return any(e.point == point for e in self._entries)
 
+    def targets(self, point: str, rank: Optional[int] = None) -> bool:
+        """Is ``point`` armed FOR THIS RANK (honoring ``:rank=R``
+        qualifiers, ignoring ``@K`` pins), without burning a one-shot
+        entry?  The ``host_lost`` startup check needs exactly this: a
+        relaunched incarnation asks "was this rank declared lost?" — a
+        question about the spec, not a firing."""
+        with self._lock:
+            return any(e.point == point
+                       and (e.rank is None or rank is None or e.rank == rank)
+                       for e in self._entries)
+
 
 class NullFaults:
     """Disabled plan — the shared default; ``fire`` never triggers."""
@@ -213,6 +237,9 @@ class NullFaults:
         return 0
 
     def has_point(self, point: str) -> bool:
+        return False
+
+    def targets(self, point: str, rank: Optional[int] = None) -> bool:
         return False
 
 

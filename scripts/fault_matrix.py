@@ -28,7 +28,8 @@ POLICIES = ("raise", "rollback", "clamp")
 FAULTS = ("none", "nan_grad@2", "inf_hess@2", "hist_fail_once",
           "torn_checkpoint@4", "collective_fail_once", "preempt@2",
           "torn_shard_rank@4", "torn_manifest@4", "rank_crash_in_barrier@4",
-          "rank_crash@3", "rank_hang@3", "slow_heartbeat", "rank_crash")
+          "rank_crash@3", "rank_hang@3", "slow_heartbeat", "rank_crash",
+          "stale_rejoin", "host_lost@4:rank=1", "host_lost@4:rank=1!strict")
 # multi-process snapshot-set faults: protocol-level cells driven through a
 # simulated 2-rank group (sequential ranks + a disk-backed gather stub, the
 # tests/test_robustness.py harness); expected outcomes below.  They do not
@@ -52,6 +53,21 @@ SUP_FAULTS = {                       # fault -> expected supervisor outcome
     #                                  still converges
     "rank_crash": "budget_exhausted",
 }
+# elastic-group cells (docs/ROBUSTNESS.md "Elastic groups"): a REAL
+# 2-process supervised group loses rank 1's host mid-run (``host_lost``
+# kills it at boundary 4 and every relaunch dies before its first
+# heartbeat — the host is not coming back).  With ``elastic_resume`` the
+# supervisor declares the host lost after ``world_shrink_after``
+# consecutive startup failures and relaunches at world=1 through the
+# elastic-resume path; the shrunk-world model must be byte-identical to
+# an uninterrupted single-process run.  The ``!strict`` variant is the
+# SAME fault with elastic healing off: the correct outcome is a clean
+# restart_budget_exhausted give-up, never a silent shrink.  Policy-blind
+# like the SUP cells: only the `raise` column runs them.
+ELASTIC_FAULTS = {                   # fault -> expected supervisor outcome
+    "host_lost@4:rank=1": "shrunk",
+    "host_lost@4:rank=1!strict": "budget_exhausted",
+}
 # the ~2-minute tier loop runs this subset (tests/test_robustness.py)
 FAST_CELLS = {("none", "raise"), ("nan_grad@2", "raise"),
               ("nan_grad@2", "rollback"), ("torn_checkpoint@4", "raise"),
@@ -59,7 +75,7 @@ FAST_CELLS = {("none", "raise"), ("nan_grad@2", "raise"),
               ("torn_shard_rank@4", "raise"), ("torn_manifest@4", "raise"),
               ("rank_crash_in_barrier@4", "raise"),
               ("rank_crash@3", "raise"), ("rank_hang@3", "raise"),
-              ("rank_crash", "raise")}
+              ("rank_crash", "raise"), ("stale_rejoin", "raise")}
 
 
 def _data():
@@ -167,6 +183,38 @@ def _run_cell(fault: str, policy: str, X, y, workdir: str) -> str:
                 return "ok" if retries else "retry was not counted"
             finally:
                 faults.clear()
+
+        if fault == "stale_rejoin":
+            # incarnation epoch fence: a process from a DEAD incarnation
+            # sends one frame into the current group.  Expected outcome
+            # (policy-blind, so all three columns pin the same contract):
+            # a terminal StaleEpochError naming BOTH epochs, no retry
+            # burned (retrying cannot make a stale process current), and
+            # a structured stale_epoch_rejected event.
+            from lightgbm_tpu.checkpoint import GROUP_EPOCH_ENV
+            counters.reset()
+            os.environ[GROUP_EPOCH_ENV] = "3"
+            faults.install("stale_rejoin")
+            try:
+                sync.allgather_object({"probe": policy})
+                return "the stale frame was not rejected"
+            except sync.StaleEpochError as e:
+                if e.frame_epoch != 2 or e.group_epoch != 3:
+                    return f"wrong epochs on the error: {e!r}"
+                if "epoch 2" not in str(e) or "epoch 3" not in str(e):
+                    return f"error does not name both epochs: {e}"
+                if counters.get("collective_retries"):
+                    return "the stale frame burned a retry (the fence " \
+                           "must be terminal)"
+                if not counters.events("stale_epoch_rejected"):
+                    return "no stale_epoch_rejected event"
+                return "ok"
+            finally:
+                faults.clear()
+                os.environ.pop(GROUP_EPOCH_ENV, None)
+
+        if fault in ELASTIC_FAULTS:
+            return _run_elastic_cell(fault, workdir)
 
         return f"unknown fault {fault!r}"
     except Exception as e:   # noqa: BLE001 - the matrix reports, not raises
@@ -363,6 +411,152 @@ def _run_sup_cell(fault: str, X, y, workdir: str) -> str:
         else "self-healed model differs from uninterrupted run"
 
 
+# the elastic worker: rank identity, world size, incarnation epoch, and the
+# host_lost fault all travel through the environment (the supervisor stamps
+# LGBM_TPU_WORLD / LGBM_TPU_GROUP_EPOCH per incarnation, the cell arms
+# LGBM_TPU_FAULT_INJECT once for every incarnation).  The data slice
+# follows the CURRENT world: at world=2 each rank trains its half, at
+# world=1 the survivor trains the union — exactly the partition the
+# elastic-resume path re-splices the committed 2-rank set onto.  Integer-
+# valued gradients keep f32 histogram sums exact under any summation
+# order, so "byte-identical across a topology change" is a meaningful pin.
+ELASTIC_WORKER = r"""
+import os, sys
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+import numpy as np
+from lightgbm_tpu.utils.cache import enable_persistent_cache
+enable_persistent_cache()
+import lightgbm_tpu as lgb
+
+def int_fobj(preds, ds):
+    y = np.asarray(ds.get_label(), np.float32)
+    g = np.clip(np.rint(np.asarray(preds, np.float64) - y), -64, 64)
+    return g.astype(np.float32), np.ones_like(g, np.float32)
+
+rng = np.random.RandomState(7)
+n, f = 1600, 8
+X = (rng.randint(0, 24, size=(n, f)) / 4.0).astype(np.float32)
+w = rng.randn(f)
+y = np.rint((X @ w) - np.median(X @ w)).astype(np.float32)
+rank = int(os.environ["LGBM_TPU_RANK"])
+world = int(os.environ.get("LGBM_TPU_WORLD", "2") or 2)
+lo, hi = (0, n) if world == 1 else ((0, n // 2) if rank == 0 else
+                                    (n // 2, n))
+params = dict(objective="regression", num_leaves=7, min_data_in_leaf=10,
+              learning_rate=0.5, verbose=-1, boost_from_average=False,
+              tree_learner="data", num_machines=2,
+              machine_list_file=os.environ["EL_MLIST"],
+              output_model=os.environ["EL_SNAP"], snapshot_freq=2,
+              snapshot_resume=True, heartbeat_interval=0.05,
+              collective_timeout=4, collective_retries=0)
+if os.environ.get("EL_ELASTIC") == "1":
+    params["elastic_resume"] = True
+bst = lgb.train(params, lgb.Dataset(X[lo:hi], label=y[lo:hi],
+                                    free_raw_data=False),
+                num_boost_round=6, verbose_eval=False, fobj=int_fobj)
+bst.save_model(os.environ["EL_OUT"] + f".rank{rank}.txt")
+"""
+
+_ELASTIC_REF = {}    # workdir -> uninterrupted single-process model text
+
+
+def _elastic_serial_ref(workdir: str) -> str:
+    """The uninterrupted baseline the shrunk world must reproduce: the
+    SAME problem and boosting params as ELASTIC_WORKER, single process,
+    no faults."""
+    if workdir not in _ELASTIC_REF:
+        import lightgbm_tpu as lgb
+        rng = np.random.RandomState(7)
+        n, f = 1600, 8
+        X = (rng.randint(0, 24, size=(n, f)) / 4.0).astype(np.float32)
+        w = rng.randn(f)
+        y = np.rint((X @ w) - np.median(X @ w)).astype(np.float32)
+
+        def int_fobj(preds, ds):
+            lab = np.asarray(ds.get_label(), np.float32)
+            g = np.clip(np.rint(np.asarray(preds, np.float64) - lab),
+                        -64, 64)
+            return g.astype(np.float32), np.ones_like(g, np.float32)
+
+        params = dict(objective="regression", num_leaves=7,
+                      min_data_in_leaf=10, learning_rate=0.5, verbose=-1,
+                      boost_from_average=False)
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=6, verbose_eval=False,
+                        fobj=int_fobj)
+        _ELASTIC_REF[workdir] = bst.model_to_string(-1)
+    return _ELASTIC_REF[workdir]
+
+
+def _run_elastic_cell(fault: str, workdir: str) -> str:
+    """One elastic-group cell (expected outcomes: ELASTIC_FAULTS).
+
+    Timeline of the ``shrunk`` cell: attempt 0 loses rank 1 at boundary 4
+    (after the iteration-2 set committed, before 4 commits); attempts 1-2
+    die at startup before a heartbeat (``host_lost`` re-arms per
+    incarnation); the supervisor evicts rank 1, pre-flights the world=1
+    mesh plan, and relaunches the survivor on the union through elastic
+    resume to the byte-identical uninterrupted model."""
+    from lightgbm_tpu.obs.counters import counters
+    from lightgbm_tpu.parallel import mesh
+    from lightgbm_tpu.supervisor import Supervisor
+
+    strict = fault.endswith("!strict")
+    spec = fault[:-len("!strict")] if strict else fault
+    d = os.path.join(workdir, "elastic_strict" if strict else "elastic")
+    os.makedirs(d, exist_ok=True)
+    script = os.path.join(workdir, "elastic_worker.py")
+    if not os.path.exists(script):
+        with open(script, "w") as f:
+            f.write(ELASTIC_WORKER)
+    mlist = os.path.join(d, "mlist.txt")
+    with open(mlist, "w") as f:
+        f.write("127.0.0.1 0\n127.0.0.1 0\n")
+    out = os.path.join(d, "model")
+    snap = os.path.join(d, "snap", "m.txt")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {"EL_MLIST": mlist, "EL_SNAP": snap, "EL_OUT": out,
+           "EL_ELASTIC": "" if strict else "1",
+           "LGBM_TPU_FAULT_INJECT": spec,
+           "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    counters.reset()
+    sup = Supervisor(
+        [sys.executable, script], snap, 2,
+        heartbeat_interval=0.05, hang_timeout=60.0,
+        restart_limit=(2 if strict else 3), restart_backoff=0.05,
+        term_grace=8.0, poll_interval=0.05, env=env,
+        prelaunch=lambda _sup: mesh.refresh_local_ports(mlist),
+        elastic_resume=not strict, world_shrink_after=2,
+        machine_list_file=mlist)
+    rc = sup.run()
+    if strict:
+        if rc == 0:
+            return "strict supervisor healed a lost host (must give up)"
+        if not counters.events("restart_budget_exhausted"):
+            return "no restart_budget_exhausted event"
+        if counters.events("world_resize"):
+            return "strict mode shrank the world"
+        return "ok"
+    if rc != 0:
+        return f"elastic supervisor gave up (exit {rc})"
+    if not counters.events("rank_evicted"):
+        return "no rank_evicted event behind the shrink"
+    resizes = counters.events("world_resize")
+    if not resizes or resizes[-1].get("world") != 1:
+        return f"world_resize missing or wrong: {resizes}"
+    final = out + ".rank0.txt"
+    if not os.path.exists(final):
+        return "no final model from the shrunk world"
+    with open(final) as f:
+        got = f.read()
+    return "ok" if got == _elastic_serial_ref(workdir) \
+        else "shrunk-world model differs from uninterrupted run"
+
+
 def run_matrix(fast: bool = False):
     """Returns (results, failures): results is {(fault, policy): msg}."""
     X, y = _data()
@@ -374,6 +568,7 @@ def run_matrix(fast: bool = False):
                     continue
                 if policy != "raise" and (fault in MP_FAULTS
                                           or fault in SUP_FAULTS
+                                          or fault in ELASTIC_FAULTS
                                           or fault == "preempt@2"):
                     continue   # checkpoint/supervisor cells are policy-blind
                 msg = _run_cell(fault, policy, X, y, workdir)
