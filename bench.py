@@ -38,8 +38,10 @@ is walked until a child prints a result line; the final JSON always appears
 on stdout, with a "degraded" field naming any fallback taken (round-1
 failure was an unreachable TPU plugin; round-2 was a Mosaic compile error
 *after* backend init — both are now survivable by construction).
-BENCH_FUSED=0 drops the fused rung — the capture playbook's forced-gen-1
-A/B (bench_1m_gen1.json) against the default ladder's headline.
+BENCH_FUSED=0 drops the fused rung — the capture playbook's forced-XLA
+A/B (bench_1m_xla.json) against the default ladder's headline.
+BENCH_MESH_FUSED=1 (with BENCH_MESH=1) swaps the mesh rung's configs for
+the gspmd_hist fused-vs-flat A/B pairs (bench_mesh_fused.json).
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
 "telemetry"[, "leaves_sweep", "degraded", "kernel_mismatch"]}.
@@ -383,12 +385,14 @@ def _mesh_rung_child():
     import jax
     from lightgbm_tpu.boosting import create_boosting
     from lightgbm_tpu.config import config_from_params
+    from lightgbm_tpu.obs.counters import counters as obs_counters
     from lightgbm_tpu.objectives import create_objective
     from lightgbm_tpu.utils import log as _log
 
     _log.set_verbosity(-1)
     n_devices = len(jax.devices())
     n_timed = int(os.environ.get("BENCH_MESH_TREES", 1))
+    fused_ab = os.environ.get("BENCH_MESH_FUSED") == "1"
     # per-shape sharding sets: feature sharding only makes sense on the
     # wide shape (its histogram pool is the planner's reason to exist),
     # and on the VIRTUAL mesh all 8 devices share one host's cores — the
@@ -404,6 +408,24 @@ def _mesh_rung_child():
         ("gspmd_auto", {"parallel_impl": "gspmd", "mesh_shape": "auto"}),
         ("shardmap_data", {"parallel_impl": "shardmap"}),
     ]
+    if fused_ab:
+        # BENCH_MESH_FUSED=1: the gspmd_hist fused-vs-flat A/B
+        # (shard_map islands + interpret-mode fused kernel vs pure-XLA
+        # scatter-add) on the data mesh AND the 2x4 hybrid mesh, where
+        # the island's partials cross the shard-sized reduction; the
+        # wide shape rides the feature mesh (2000 cols / 8 shards = 250
+        # per device — inside the kernel's 512-col ceiling)
+        def _pair(ms):
+            return [
+                (f"gspmd_flat_{ms}",
+                 {"parallel_impl": "gspmd", "mesh_shape": ms,
+                  "gspmd_hist": "flat"}),
+                (f"gspmd_fused_{ms}",
+                 {"parallel_impl": "gspmd", "mesh_shape": ms,
+                  "gspmd_hist": "fused"}),
+            ]
+        configs_narrow = _pair("data") + _pair("2x4")
+        configs_wide = _pair("feature")
     shapes = [
         (int(os.environ.get("BENCH_MESH_ROWS", 200_000)),
          int(os.environ.get("BENCH_MESH_FEATURES", 28)),
@@ -433,6 +455,10 @@ def _mesh_rung_child():
                     lambda: make_data(rows, feats, 0.0), cfg, rows, feats,
                     0.0, p)
             try:
+                # fresh counters per config: the observed-kernel identity
+                # and any layout_downgrade events below belong to THIS
+                # configuration, not whatever trained before it
+                obs_counters.reset()
                 booster = create_boosting(cfg, ds, create_objective(cfg))
                 booster.train_one_iter()          # warmup (compile)
                 jax.block_until_ready(booster.scores)
@@ -443,8 +469,12 @@ def _mesh_rung_child():
                 dt = (time.perf_counter() - t0) / n_timed
                 rec = {"trees_per_sec": round(1.0 / dt, 4),
                        "impl": booster._parallel_impl,
+                       "observed_kernel": obs_counters.observed_kernel(),
                        "collectives": booster.grow_hlo_census(
                            label=f"{key}:{name}")}
+                downs = obs_counters.events("layout_downgrade")
+                if downs:
+                    rec["downgrades"] = downs
                 if booster._gspmd_plan is not None:
                     plan = booster._gspmd_plan
                     rec["mesh"] = f"{plan.data}x{plan.feature}"
@@ -458,17 +488,28 @@ def _mesh_rung_child():
         if "trees_per_sec" in g and "trees_per_sec" in s:
             rows_out["gspmd_vs_shardmap"] = round(
                 g["trees_per_sec"] / s["trees_per_sec"], 3)
+        for ms in ("data", "2x4", "feature"):
+            fu = rows_out.get(f"gspmd_fused_{ms}", {})
+            fl = rows_out.get(f"gspmd_flat_{ms}", {})
+            if "trees_per_sec" in fu and "trees_per_sec" in fl:
+                rows_out[f"fused_vs_flat_{ms}"] = round(
+                    fu["trees_per_sec"] / fl["trees_per_sec"], 3)
+                if headline is None and ms == "data":
+                    headline = fu["trees_per_sec"]
         out_shapes[key] = rows_out
         if headline is None:
             headline = g.get("trees_per_sec", 0.0)
     result = {
-        "metric": f"mesh GSPMD-vs-shardmap data-parallel training "
-                  f"(cpu, forced {n_devices}-device host mesh)",
+        "metric": (f"mesh gspmd_hist fused-vs-flat A/B "
+                   f"(cpu, forced {n_devices}-device host mesh)"
+                   if fused_ab else
+                   f"mesh GSPMD-vs-shardmap data-parallel training "
+                   f"(cpu, forced {n_devices}-device host mesh)"),
         "value": headline or 0.0,
         "unit": "trees/sec",
         "vs_baseline": None,
         "mesh": {"devices": n_devices, "timed_trees": n_timed,
-                 "shapes": out_shapes},
+                 "fused_ab": fused_ab, "shapes": out_shapes},
     }
     print(json.dumps(result))
 
@@ -489,8 +530,8 @@ def child_main():
                 flags + " --xla_force_host_platform_device_count=8").strip()
         _mesh_rung_child()
         return
-    #                      fused | pallas | einsum | segment (cpu)
-    use_pallas = mode in ("fused", "pallas")
+    #                      fused | einsum | segment (cpu)
+    use_pallas = mode == "fused"
     if platform_want == "cpu":
         os.environ["PALLAS_AXON_POOL_IPS"] = ""             # skip axon plugin
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -585,7 +626,7 @@ def child_main():
     # as a fused number
     resolved = booster.grower_cfg.hist_method
     kernel_tag = (f", {resolved}" if platform == "tpu"
-                  and resolved in ("fused", "pallas") else "")
+                  and resolved == "fused" else "")
 
     # rung honesty: the telemetry dispatch counters record which kernel the
     # grower ACTUALLY traced.  A disagreement with the resolved label (e.g.
@@ -749,7 +790,7 @@ def _rung_label(platform: str, mode: str) -> str:
     """Human label for a ladder rung: tpu+fused / tpu+pallas / tpu (einsum)
     / cpu — the tpu/cpu spellings predate the fused rung and are kept so
     degradation strings stay comparable across rounds."""
-    return f"{platform}+{mode}" if mode in ("fused", "pallas") else platform
+    return f"{platform}+{mode}" if mode == "fused" else platform
 
 
 def _run_child(platform: str, mode: str, timeout_s: int):
@@ -850,14 +891,14 @@ def main():
         return
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
     want = os.environ.get("BENCH_PLATFORM")  # force 'cpu' or 'tpu'
-    ladder = [("tpu", "fused"), ("tpu", "pallas"), ("tpu", "einsum"),
-              ("cpu", "segment")]
+    ladder = [("tpu", "fused"), ("tpu", "einsum"), ("cpu", "segment")]
     if want == "cpu":
         ladder = [("cpu", "segment")]
     elif want == "tpu":
-        ladder = [("tpu", "fused"), ("tpu", "pallas"), ("tpu", "einsum")]
+        ladder = [("tpu", "fused"), ("tpu", "einsum")]
     if os.environ.get("BENCH_FUSED") == "0":
-        # the capture playbook's forced-gen-1 A/B partner (bench_1m_gen1)
+        # the capture playbook's forced-XLA A/B partner (bench_1m_xla):
+        # drop the fused rung so the ladder lands on the einsum reference
         ladder = [r for r in ladder if r[1] != "fused"]
     if ladder[0][0] == "tpu" and not _tpu_reachable(probe_timeout):
         sys.stderr.write("bench: tpu unreachable, skipping tpu rungs\n")

@@ -237,22 +237,20 @@ class GBDT:
             lambda_l2=cfg.lambda_l2,
             min_gain_to_split=cfg.min_gain_to_split,
             max_bin=train.max_num_bin(),
-            # fused (gen-2, in-kernel gather) sits above pallas on the TPU
-            # rung ladder but stays OPT-IN (pallas_fused=on) while 'auto'
-            # resolves to the hardware-proven gen-1 kernel — the same
-            # discipline as the nibble impl's 'auto'; the bench ladder's
-            # tpu+fused rung is the A/B that flips this default
+            # the ladder is fused-vs-reference since the gen-1 kernels
+            # were retired: on TPU, use_pallas runs the fused in-kernel-
+            # gather rung ('auto' and 'on' alike — it is the ONLY Pallas
+            # kernel left, and the lowering-proven one); pallas_fused=off
+            # / use_pallas=false force the MXU-shaped einsum oracle;
+            # off-TPU picks the cpu_hist_method reference
             hist_method=("fused" if cfg.use_pallas and _on_tpu()
-                         and cfg.pallas_fused == "on"
-                         else "pallas" if cfg.use_pallas and _on_tpu()
+                         and cfg.pallas_fused != "off"
                          else "einsum" if _on_tpu()   # MXU-friendly debug
                          else cfg.cpu_hist_method),   # scatter-add on CPU
-            feat_tile=cfg.pallas_feat_tile,
             row_tile=cfg.pallas_row_tile,
             bucket_min_log2=cfg.pallas_bucket_min_log2,
             gather_words=cfg.gather_words,
             gather_panel=cfg.gather_panel,
-            hist_impl=cfg.pallas_hist_impl,
             ordered_bins=("off" if cfg.ordered_bins == "auto"
                           else cfg.ordered_bins),
             partition_impl=("scatter" if cfg.partition_impl == "auto"
@@ -667,26 +665,41 @@ class GBDT:
         XLA owns the data-plane collectives from here;
         ``parallel/sync.py``'s host ladder keeps the control plane."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from .grower import fused_gate_reason
         from .parallel import gspmd as gspmd_mod
         from .parallel import mesh as mesh_mod
-        # the partitioner owns the layout: Pallas kernels are manual-
-        # layout custom calls it cannot split, and the chunked-scan
-        # histograms make it all-gather the row shards — the flat
-        # scatter-add is the one partitionable formulation, so any other
-        # request is downgraded loudly BEFORE labels are read
-        if self.grower_cfg.hist_method != "segment":
-            log.warning("hist_method=%s is unavailable under "
-                        "parallel_impl=gspmd (the SPMD partitioner cannot "
-                        "split Pallas custom calls); using the flat "
-                        "segment-sum histogram",
-                        self.grower_cfg.hist_method)
-            obs_counters.event(
-                "layout_downgrade", stage="boosting",
-                requested=f"hist_method={self.grower_cfg.hist_method}",
-                resolved="segment", reason="gspmd partitioner owns the "
-                "histogram layout")
-            self.grower_cfg = self.grower_cfg._replace(
-                hist_method="segment")
+        # histogram formulation under gspmd (``gspmd_hist``): flat (the
+        # masked whole-partition scatter-add — pure XLA, the forced A/B
+        # partner) or fused (the shard_map hybrid: the fused Pallas
+        # kernel per row shard, partitioner-owned cross-shard reduction).
+        # ``auto`` stays flat until the on-chip A/B flips it
+        # (capture-backlog discipline, scripts/decide_flips.py).  The
+        # serial TPU/CPU ladder baked into grower_cfg.hist_method does
+        # not apply here — the partitioner owns the layout.
+        gspmd_hist = "flat" if cfg.gspmd_hist == "auto" else cfg.gspmd_hist
+        hist_width = (max(256, self.grower_cfg.max_bin)
+                      if self._pack_plan is not None
+                      else self.grower_cfg.max_bin)
+        sc_cols = (self._pack_plan.num_storage_cols
+                   if self._pack_plan is not None
+                   else int(np.shape(self.bins)[1]))
+        hist_mat = (self._hist_bins if self._pack_plan is not None
+                    else self.bins)
+        hist_dtype = np.asarray(hist_mat).dtype
+        if gspmd_hist == "fused":
+            # shape-independent gate (the shape-dependent half runs after
+            # the mesh plan below): downgrade loudly BEFORE labels are
+            # read, per the rung-honesty discipline
+            reason = fused_gate_reason(hist_dtype, jnp.float32, hist_width,
+                                       1, False)
+            if reason is not None:
+                log.warning("gspmd_hist=fused unavailable (%s); using the "
+                            "flat scatter-add histogram", reason)
+                obs_counters.event(
+                    "layout_downgrade", stage="boosting",
+                    requested="gspmd_hist=fused", resolved="flat",
+                    reason=reason)
+                gspmd_hist = "flat"
         nd = min(cfg.mesh_devices or n_devices, n_devices)
         prefer = {"data": "data", "feature": "feature",
                   "data_feature": "square"}.get(cfg.tree_learner, "data")
@@ -701,7 +714,8 @@ class GBDT:
             bin_bytes=int(np.asarray(self.bins).dtype.itemsize),
             packed_cols=(self._pack_plan.num_storage_cols
                          if self._pack_plan is not None else 0),
-            valid_rows=sum(vs.data.num_data for vs in self.valid_sets))
+            valid_rows=sum(vs.data.num_data for vs in self.valid_sets),
+            gspmd_fused=(gspmd_hist == "fused"))
         if explicit is not None:
             d, f = explicit
             from .obs.memory import predict_hbm
@@ -725,6 +739,32 @@ class GBDT:
             plan = plan._replace(block_shard_bins=False)
         elif sa in ("batch,feature", "feature,batch"):
             plan = plan._replace(block_shard_bins=True)
+        if gspmd_hist == "fused":
+            # shape-dependent half of the fused gate, now that the mesh
+            # extents are known: each device's column slice must be exact
+            # (shard_map even-split) and fit the kernel's column ceiling
+            if sc_cols % plan.feature != 0:
+                reason = (f"{sc_cols} histogram columns do not split "
+                          f"evenly over {plan.feature} feature shards")
+            else:
+                reason = fused_gate_reason(hist_dtype, jnp.float32,
+                                           hist_width,
+                                           sc_cols // plan.feature, False)
+            if reason is not None:
+                log.warning("gspmd_hist=fused unavailable (%s); using the "
+                            "flat scatter-add histogram", reason)
+                obs_counters.event(
+                    "layout_downgrade", stage="boosting",
+                    requested="gspmd_hist=fused", resolved="flat",
+                    reason=reason)
+                gspmd_hist = "flat"
+        # the gspmd builder keys off hist_method: "fused" = hybrid island,
+        # anything else = flat (recorded as method=segment by dispatch).
+        # Off-TPU the island runs the kernel's interpret mode — same
+        # program shape, Pallas emulated — so the hybrid is CPU-testable.
+        self.grower_cfg = self.grower_cfg._replace(
+            hist_method="fused" if gspmd_hist == "fused" else "segment",
+            hist_interpret=(gspmd_hist == "fused" and not _on_tpu()))
         obs_counters.event(
             "mesh_plan", data=plan.data, feature=plan.feature,
             block_shard_bins=plan.block_shard_bins,
@@ -756,7 +796,7 @@ class GBDT:
                  plan.reason)
         self.grow = gspmd_mod.make_gspmd_grower(
             self.grower_cfg, mesh, bundled=self.meta.col is not None,
-            pack_plan=self._pack_plan)
+            pack_plan=self._pack_plan, block_shard=plan.block_shard_bins)
 
     def grow_hlo_census(self, label: str = "grow") -> Dict[str, Dict[str, int]]:
         """Compiled-HLO collective census of the CURRENT grower

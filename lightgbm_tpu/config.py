@@ -400,6 +400,18 @@ class Config:
                                    # pressure forces block sharding) |
                                    # batch | batch,feature (row x column
                                    # block sharding)
+    gspmd_hist: str = "auto"       # histogram formulation inside the
+                                   # gspmd program: flat (masked whole-
+                                   # partition scatter-add — pure XLA,
+                                   # any layout, the forced A/B partner)
+                                   # | fused (the hybrid: each device
+                                   # runs the fused Pallas gather-
+                                   # histogram over its row shard inside
+                                   # a shard_map island; unfusable
+                                   # layouts downgrade loudly to flat)
+                                   # | auto (flat until the on-chip A/B
+                                   # flips it — capture-backlog
+                                   # discipline, scripts/decide_flips.py)
     collective_timeout: float = 120.0  # seconds one host-object collective
                                        # attempt may block before it is
                                        # failed and retried (parallel/sync.py)
@@ -414,7 +426,6 @@ class Config:
     hist_dtype: str = "float32"    # accumulator dtype for histograms
     use_pallas: bool = True        # Pallas hist kernel on TPU
     cpu_hist_method: str = "segment"   # off-TPU histogram: segment | einsum
-    pallas_feat_tile: int = 8      # kernel grid: features per block
     pallas_row_tile: int = 512     # kernel grid: rows per block
     pallas_bucket_min_log2: int = 6    # smallest pow2 gather bucket (64
                                        # rows: deep-tree tail splits pay
@@ -426,7 +437,6 @@ class Config:
     gather_panel: str = "auto"     # fold the f32 weight columns into the
                                    # word matrix so each split's read is
                                    # ONE row gather: auto | on | off
-    pallas_hist_impl: str = "auto"  # kernel form: auto | onehot | nibble
     split_find: str = "fused"      # best-split scan formulation: fused
                                    # (gain scan fused onto the hot
                                    # histogram — per-direction reductions,
@@ -435,12 +445,13 @@ class Config:
                                    # historical packed-argmax form, kept as
                                    # the forced A/B baseline).  Trees are
                                    # bit-identical either way (pinned)
-    pallas_fused: str = "auto"     # gen-2 fused-gather nibble histogram
-                                   # kernel (in-kernel row DMA, no gather
-                                   # pass, no pow2 staging buffer):
-                                   # auto | on | off; 'auto' stays on the
-                                   # hardware-proven gen-1 kernel until
-                                   # the on-chip A/B flips it
+    pallas_fused: str = "auto"     # fused-gather nibble histogram kernel
+                                   # (in-kernel row DMA, no gather pass,
+                                   # no pow2 staging buffer): auto | on
+                                   # | off; the ONLY Pallas rung since
+                                   # the gen-1 kernels were retired —
+                                   # 'auto'/'on' run it on TPU, 'off'
+                                   # forces the einsum reference oracle
     ordered_bins: str = "auto"     # leaf-ordered bin matrix (OrderedBin
                                    # analogue): auto | on | off; 'on' trades
                                    # wide partition scatters for contiguous
@@ -610,9 +621,6 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.pallas_row_tile <= 0 or cfg.pallas_row_tile % 128 != 0:
         log.fatal("pallas_row_tile must be a positive multiple of 128 "
                   "(the TPU lane width); got %d", cfg.pallas_row_tile)
-    if cfg.pallas_feat_tile <= 0:
-        log.fatal("pallas_feat_tile must be positive; got %d",
-                  cfg.pallas_feat_tile)
     if cfg.pallas_bucket_min_log2 < 0 or cfg.pallas_bucket_min_log2 > 26:
         log.fatal("pallas_bucket_min_log2 must be in [0, 26]; got %d",
                   cfg.pallas_bucket_min_log2)
@@ -622,12 +630,12 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.gather_panel not in ("auto", "on", "off"):
         log.fatal("gather_panel must be auto, on, or off; got %r",
                   cfg.gather_panel)
-    if cfg.pallas_hist_impl not in ("auto", "onehot", "nibble"):
-        log.fatal("pallas_hist_impl must be auto, onehot, or nibble; got %r",
-                  cfg.pallas_hist_impl)
     if cfg.pallas_fused not in ("auto", "on", "off"):
         log.fatal("pallas_fused must be auto, on, or off; got %r",
                   cfg.pallas_fused)
+    if cfg.gspmd_hist not in ("auto", "fused", "flat"):
+        log.fatal("gspmd_hist must be auto, fused, or flat; got %r",
+                  cfg.gspmd_hist)
     if cfg.split_find not in ("fused", "chain"):
         log.fatal("split_find must be fused or chain; got %r",
                   cfg.split_find)
@@ -721,26 +729,6 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.world_shrink_after < 1:
         log.fatal("world_shrink_after must be >= 1 consecutive startup "
                   "failures; got %d", cfg.world_shrink_after)
-    if cfg.pallas_hist_impl == "nibble":
-        # the nibble kernel factors bins as hi*16+lo over a 256-wide padded
-        # axis and tiles (feat_tile * 16) output lanes — reject shapes it
-        # cannot serve here instead of a bare assert inside jit tracing
-        # bin packing widens the kernel histogram axis to the 256-bin
-        # joint index, so the gate is on the EFFECTIVE width, not raw
-        # max_bin (advisor r4)
-        eff_bins = max(256, cfg.max_bin) if cfg.enable_bin_packing \
-            else cfg.max_bin
-        if eff_bins <= 128:
-            log.fatal("pallas_hist_impl=nibble needs an effective histogram "
-                      "width > 128 (the one-hot kernel already sits on the "
-                      "128-lane floor below that); got max_bin=%d with "
-                      "enable_bin_packing=false", cfg.max_bin)
-        if (cfg.pallas_feat_tile * 16) % 128 != 0:
-            log.fatal("pallas_hist_impl=nibble needs pallas_feat_tile*16 "
-                      "divisible by 128 (got pallas_feat_tile=%d)",
-                      cfg.pallas_feat_tile)
-
-
 def parse_serving_buckets(spec) -> tuple:
     """``serving_buckets`` ("1,8,64,512,4096") -> ascending int tuple;
     raises ValueError on empty/non-positive/non-ascending specs so config

@@ -42,7 +42,7 @@ def pack_gather_words(mat):
     uint16) bin columns into each uint32 word cuts the gathered element
     count 4x (2x), and the unpack after the gather is a handful of
     shift/mask vector ops that XLA fuses into the consumer.  The same
-    word layout is what the gen-2 fused histogram kernel's in-kernel row
+    word layout is what the fused histogram kernel's in-kernel row
     DMA reads (ops/pallas_hist.hist6_fused)."""
     import jax.numpy as jnp
     n, c = mat.shape
@@ -76,7 +76,7 @@ FUSED_PANEL_LANES = 128    # panel minor dim is padded to this multiple:
 
 
 def pack_fused_panel(bins_pad, gw_pad, hw_pad, cw_pad):
-    """The u32 row layout the gen-2 fused histogram kernel DMAs per row:
+    """The u32 row layout the fused histogram kernel DMAs per row:
     [N(+1), C] uint8/uint16 bins + three f32 weight columns ->
     ([N(+1), ceil((W + 3) / 128) * 128] uint32, lanes_per_word).
 

@@ -45,7 +45,7 @@ from .data.packing import (PACK_JOINT_BINS, pack_fused_panel,
                            unpack_gather_words)
 from .obs import trace as obs_trace
 from .obs.counters import counters as obs_counters
-from .ops.histogram import subset_histogram, subset_histogram_fused
+from .ops.histogram import on_tpu, subset_histogram, subset_histogram_fused
 from .ops.pallas_hist import FUSED_MAX_COLS, NIB, fused_idx_fetch
 from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
                         best_split, leaf_output, make_fused_ctx)
@@ -62,18 +62,16 @@ class GrowerConfig(NamedTuple):
     lambda_l2: float = 0.0
     min_gain_to_split: float = 0.0
     max_bin: int = 256               # B: histogram width (max over features)
-    hist_method: str = "auto"        # fused | pallas | einsum | segment
-                                     # | auto (fused = gen-2 in-kernel
-                                     # gather; falls back to pallas when
-                                     # the layout cannot fuse)
-    feat_tile: int = 8               # Pallas grid: features per block
+    hist_method: str = "auto"        # fused | einsum | segment | auto
+                                     # (fused = the in-kernel-gather Pallas
+                                     # rung; falls back to an XLA reference
+                                     # rung when the layout cannot fuse)
     row_tile: int = 512              # Pallas grid: rows per block
     bucket_min_log2: int = 6         # smallest pow2 gather-buffer bucket
     #                                  (64 rows: tail splits of deep trees
     #                                  stop paying kilobucket padding —
     #                                  round-7 leaves-sweep measurement)
     gather_words: str = "auto"       # word-pack bin columns for row gathers
-    hist_impl: str = "auto"          # pallas kernel form: onehot | nibble
     ordered_bins: str = "off"        # leaf-ordered bin matrix: on | off
     partition_impl: str = "scatter"  # window partition: scatter | sort
                                      # | compact (Pallas kernel)
@@ -88,9 +86,9 @@ class GrowerConfig(NamedTuple):
     cat_smooth_ratio: float = 0.01
     min_cat_smooth: float = 5.0
     max_cat_smooth: float = 100.0
-    hist_interpret: bool = False     # run Pallas hist kernels in interpret
-                                     # mode — CPU-side parity tests of the
-                                     # fused/pallas paths (never on-chip)
+    hist_interpret: bool = False     # run the fused Pallas kernel in
+                                     # interpret mode — CPU-side parity
+                                     # tests (never on-chip)
     split_find: str = "fused"        # best-split scan formulation: fused
                                      # (per-direction reductions right off
                                      # the hot histogram, loop-invariant
@@ -160,15 +158,15 @@ def decode_bundle_bin(raw, feat, meta: FeatureMeta):
 
 
 # pack_gather_words / unpack_gather_words moved to data/packing.py (the
-# gen-2 fused kernel DMAs the same word layout in-kernel); imported above
-# so existing call sites — including scripts/tpu_microprobe.py — keep
+# fused kernel DMAs the same word layout in-kernel); imported above so
+# existing call sites — including scripts/tpu_microprobe.py — keep
 # working unchanged.
 
 
 def fused_gate_reason(bins_dtype, weights_dtype, hist_width: int,
                       n_hist_cols: int, use_ordered: bool):
-    """None when the gen-2 fused-gather kernel can run on this layout,
-    else the human-readable reason it cannot.
+    """None when the fused-gather kernel can run on this layout, else the
+    human-readable reason it cannot.
 
     Shared by the grower's trace-time gate AND boosting's method
     resolution: the resolved ``hist_method`` must always name the kernel
@@ -449,12 +447,9 @@ def _bucket_sizes(cfg: "GrowerConfig", n: int):
     ``pow2``: {2^k} — avg padding ~1.44x of the leaf count.
     ``pow15``: {2^k, 3*2^(k-1)} — avg padding ~1.21x at 2x the branch
     count (compile cost is one-time via the persistent cache; runtime
-    executes exactly one branch either way).  Buckets below 512 rows use
-    a NARROW Pallas row_tile (the bucket rounded up to the 128-lane
-    floor, ``_bucket_row_tile``) so deep-tree tail splits stop padding
-    their handful of rows to a full 512-row kernel tile; at
-    bucket_min_log2 >= 9 every size is a multiple of 512 and the
-    configured row_tile applies unchanged."""
+    executes exactly one branch either way).  These buckets serve only
+    the XLA reference rungs (segment/einsum): the fused Pallas rung's
+    dynamic grid retires the staging switch entirely."""
     kmin = cfg.bucket_min_log2
     kmax = max(int(n - 1).bit_length(), kmin)
     sizes = {1 << k for k in range(kmin, kmax + 1)}
@@ -464,15 +459,6 @@ def _bucket_sizes(cfg: "GrowerConfig", n: int):
     while sizes[-1] < n:      # coverage: largest bucket must hold n rows
         sizes.append(sizes[-1] * 2)
     return sizes
-
-
-def _bucket_row_tile(cfg: "GrowerConfig", size: int) -> int:
-    """Pallas row tile for a gather bucket: the configured tile, shrunk
-    to the bucket (rounded up to the 128-lane tiling floor) for the
-    sub-512 tail buckets so a 64-row split costs a 128-row kernel launch
-    instead of a 512-row one.  The dropped padding rows all carry zero
-    weight, so the histogram totals are unchanged."""
-    return min(cfg.row_tile, max(128, -(-size // 128) * 128))
 
 
 def _bucket_index(scnt, sizes):
@@ -629,26 +615,28 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                 requested="gather_panel=on", resolved="off",
                 reason=f"needs gather_words on and float32 weights "
                        f"(words={use_words}, dtype={dtype})")
-        # gen-2 fused-gather histogram rung: the kernel DMAs the indexed
-        # panel rows itself, so the gather-bucket lax.switch (and its pow2
+        # fused-gather histogram rung: the kernel DMAs the indexed panel
+        # rows itself, so the gather-bucket lax.switch (and its pow2
         # staging buffer) is RETIRED on this path — no ``branches`` are
         # traced at all.  The layout prerequisites mirror the gather
-        # panel's; anything outside them degrades loudly to the
-        # hardware-proven gen-1 pallas rung (the A/B harness must never
-        # record mislabeled numbers).
+        # panel's; anything outside them degrades loudly to an XLA
+        # reference rung (the A/B harness must never record mislabeled
+        # numbers): einsum on TPU (the MXU-shaped form), segment on CPU.
         n_hist_cols = hbins.shape[1]
         use_fused = cfg.hist_method == "fused"
+        fallback_method = "einsum" if on_tpu() else "segment"
         if use_fused:
             reason = fused_gate_reason(hbins.dtype, dtype, hist_width,
                                        n_hist_cols, use_ordered)
             if reason is not None:
                 log.warning("hist_method=fused unavailable (%s); using the "
-                            "gen-1 pallas kernel", reason)
+                            "%s reference path", reason, fallback_method)
                 obs_counters.event("layout_downgrade", stage="grower",
-                                   requested="fused", resolved="pallas",
+                                   requested="fused",
+                                   resolved=fallback_method,
                                    reason=reason)
                 use_fused = False
-        base_method = "pallas" if cfg.hist_method == "fused" \
+        base_method = fallback_method if cfg.hist_method == "fused" \
             else cfg.hist_method
         if use_fused:
             # the fused panel subsumes the word/panel gather staging —
@@ -680,14 +668,9 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                     jax.named_scope("split_find"):
                 return strategy.find(ctx, hist, pg, ph, pc, feat_ok)
 
-        def hist_subset(rows, g_, h_, c_, site="split", row_tile=None):
+        def hist_subset(rows, g_, h_, c_, site="split"):
             return subset_histogram(rows, g_, h_, c_, hist_width,
-                                    method=base_method,
-                                    feat_tile=cfg.feat_tile,
-                                    row_tile=row_tile or cfg.row_tile,
-                                    impl=cfg.hist_impl,
-                                    interpret=cfg.hist_interpret,
-                                    site=site)
+                                    method=base_method, site=site)
 
         def hist_fused_window(order, sstart, scnt):
             """Fused rung: histogram the window [sstart, sstart + scnt) of
@@ -701,7 +684,7 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                 num_row_tiles=nt.astype(jnp.int32),
                 interpret=cfg.hist_interpret, site="split")
 
-        def measure(idx, row_tile=None):
+        def measure(idx):
             """RAW histogram of rows ``idx`` (sentinel-padded): packed
             storage columns stay in joint form so a cross-shard psum
             moves one 256-bin histogram per packed PAIR; ``globalize``
@@ -714,15 +697,14 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                 g_, h_, c_ = (lax.bitcast_convert_type(pan[:, n_words + k],
                                                        jnp.float32)
                               for k in range(3))
-                return hist_subset(rows, g_, h_, c_, row_tile=row_tile)
+                return hist_subset(rows, g_, h_, c_)
             if use_words == "on":
                 rows = unpack_gather_words(
                     hwords_pad.at[idx].get(mode="promise_in_bounds"),
                     hbins_pad.shape[1], words_per)
             else:
                 rows = hbins_pad.at[idx].get(mode="promise_in_bounds")
-            return hist_subset(rows, gw_pad[idx], hw_pad[idx], cw_pad[idx],
-                               row_tile=row_tile)
+            return hist_subset(rows, gw_pad[idx], hw_pad[idx], cw_pad[idx])
 
         def globalize(hist):
             """reduce across shards, then unfold packed columns."""
@@ -732,8 +714,6 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
             return hist
 
         def bucket_branch(size):
-            rt = _bucket_row_tile(cfg, size)
-
             def branch(args):
                 order, obins, ow, sstart, scnt = args
                 if use_ordered:
@@ -743,11 +723,10 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                     mask = (jnp.arange(size, dtype=jnp.int32)
                             < scnt).astype(wwt.dtype)
                     return hist_subset(wb, wwt[:, 0] * mask,
-                                       wwt[:, 1] * mask, wwt[:, 2] * mask,
-                                       row_tile=rt)
+                                       wwt[:, 1] * mask, wwt[:, 2] * mask)
                 idx = lax.dynamic_slice(order, (sstart,), (size,))
                 valid = jnp.arange(size, dtype=jnp.int32) < scnt
-                return measure(jnp.where(valid, idx, n), row_tile=rt)
+                return measure(jnp.where(valid, idx, n))
             return branch
 
         # fused rung: no gather buckets are traced at all — the pow2
@@ -838,12 +817,12 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                 if use_compact:
                     from .ops.pallas_compact import compact_window
                     # interpret tracks the COMPILE TARGET, not the host
-                    # backend: hist_method=="pallas" means this program is
-                    # being lowered for a real TPU (incl. AOT lowering
-                    # from a CPU host, tests/test_mosaic_aot.py) and the
-                    # kernel must go through Mosaic; anything else is the
+                    # backend: an un-interpreted fused program is being
+                    # lowered for a real TPU (incl. AOT lowering from a
+                    # CPU host, tests/test_mosaic_aot.py) and the kernel
+                    # must go through Mosaic; anything else is the
                     # CPU/interpret path
-                    interp = cfg.hist_method != "pallas"
+                    interp = cfg.hist_method != "fused" or cfg.hist_interpret
                     if use_ordered:
                         payload, info = payload_cols()
                         new_win, newpay, nl = compact_window(
@@ -952,10 +931,9 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
                 jax.named_scope("histogram"):
             if use_fused:
                 # the fused rung is SELF-CONTAINED: the root histogram goes
-                # through the fused kernel too (static grid over the identity
-                # prefix of order0), because the gen-1 kernels' 3-D one-hot
-                # no longer Mosaic-lowers on current jax/libtpu (the fused
-                # kernel is the lowering-proven path; see test_mosaic_aot)
+                # through the fused kernel too (static grid over the
+                # identity prefix of order0) — it is the one
+                # lowering-proven Pallas path (see test_mosaic_aot)
                 hist_root = globalize(subset_histogram_fused(
                     order0, fused_panel, 0, n, n_hist_cols, fused_per,
                     hist_width, row_tile=cfg.row_tile,
@@ -1088,8 +1066,8 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
             with tracer.span("histogram", site="split", traced=True), \
                     jax.named_scope("histogram"):
                 if use_fused:
-                    # gen-2: the kernel gathers the window rows itself from
-                    # the fused panel — no bucket switch, no staging buffer
+                    # the kernel gathers the window rows itself from the
+                    # fused panel — no bucket switch, no staging buffer
                     hist_small = hist_fused_window(order, sstart, scnt)
                 else:
                     ki = _bucket_index(scnt, bsizes)

@@ -5,15 +5,14 @@ optional tags, bounded structured events, and gauges.  The load-bearing
 users:
 
 * **histogram-kernel dispatch identity** — every dispatch site records
-  ``hist_dispatch`` tagged ``method=fused|pallas|einsum|segment`` (plus
-  ``pallas_impl`` tagged ``impl=onehot|nibble`` once the gen-1 kernel
-  resolves its form), so a ``BENCH_*.json`` can prove which kernel a rung
-  *actually* traced instead of trusting its label
-  (:func:`observed_kernel`, consumed by ``bench.py`` /
-  ``scripts/decide_flips.py``);
-* **layout-downgrade events** — the warn-once fallback paths (fused gate,
-  nibble width gate, gather_words/panel gating) also record a
-  ``layout_downgrade`` event with the machine-readable reason;
+  ``hist_dispatch`` tagged ``method=fused|einsum|segment``, so a
+  ``BENCH_*.json`` can prove which kernel a rung *actually* traced
+  instead of trusting its label (:func:`observed_kernel`, consumed by
+  ``bench.py`` / ``scripts/decide_flips.py``);
+* **layout-downgrade events** — the warn-once fallback paths (fused
+  gate, ``gspmd_hist=fused`` mesh gating, gather_words/panel gating)
+  also record a ``layout_downgrade`` event with the machine-readable
+  reason;
 * **collective accounting** — ``obs/collectives.py`` feeds
   ``collective_calls`` / ``collective_bytes`` tagged by op + site;
 * **checkpoint lifecycle events** — the resume paths

@@ -328,7 +328,8 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
                 serving_bins: int = 0,
                 serving_buckets: Sequence[int] = (),
                 data_shards: int = 1, feature_shards: int = 1,
-                block_shard_bins: bool = False) -> Dict[str, Any]:
+                block_shard_bins: bool = False,
+                gspmd_fused: bool = False) -> Dict[str, Any]:
     """Analytic device-memory model of one training (the codified
     ``docs/MEMORY.md`` audit; that doc's table is generated from this
     function by ``scripts/gen_memory_doc.py``).
@@ -396,13 +397,33 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
         # broadcast (g, h, c) value rows) covers this device's row shard
         # x its histogram columns (all columns when the binned matrix is
         # replicated along ``feature``, its own slice when block-sharded)
-        fcols = -(-features // (fs if block_shard_bins else 1))
-        transients = {
-            "hist_scatter": rows_d * fcols * 16,
-            # row_leaf carry + routing column + child mask
-            "row_leaf": 3 * rows_d * 4,
-            "hist_store": pool_bytes,
-        }
+        if gspmd_fused:
+            # hybrid grower (gspmd_hist=fused): each device packs its
+            # (row shard x feature slice) of the binned matrix into the
+            # gather-word panel once per grow and runs the fused Mosaic
+            # kernel per split — the scatter workspace is replaced by
+            # the resident-sized panel plus the compacted order vector
+            # (with its aligned over-fetch tail)
+            sc = int(packed_cols) or features
+            cols_d = -(-sc // fs)
+            per = 4 if bin_bytes == 1 else 2
+            words = -(-(-(-cols_d // 8) * 8) // per) + 3
+            width = -(-words // 128) * 128
+            transients = {
+                "fused_panel": (rows_d + 1) * width * 4,
+                "fused_order": (rows_d + 2048) * 4,
+                # row_leaf carry + routing column + child mask
+                "row_leaf": 3 * rows_d * 4,
+                "hist_store": pool_bytes,
+            }
+        else:
+            fcols = -(-features // (fs if block_shard_bins else 1))
+            transients = {
+                "hist_scatter": rows_d * fcols * 16,
+                # row_leaf carry + routing column + child mask
+                "row_leaf": 3 * rows_d * 4,
+                "hist_store": pool_bytes,
+            }
     else:
         transients = {
             # sentinel-padded copy of the histogram inputs (hbins_pad +
@@ -443,7 +464,8 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
                    "ordered_bins": bool(ordered_bins),
                    "gather_words": bool(gather_words),
                    "data_shards": d, "feature_shards": fs,
-                   "block_shard_bins": bool(block_shard_bins)},
+                   "block_shard_bins": bool(block_shard_bins),
+                   "gspmd_fused": bool(gspmd_fused)},
         "residents": residents,
         "transients": transients,
         "resident_bytes": resident_bytes,

@@ -133,7 +133,7 @@ def _split_tags(key: str) -> Dict[str, str]:
 
 def kernel_table(counters: Dict[str, Dict[str, float]]) -> List[Dict[str, Any]]:
     rows = []
-    for name in ("hist_dispatch", "pallas_impl"):
+    for name in ("hist_dispatch",):
         for key, v in sorted(counters.get(name, {}).items()):
             tags = _split_tags(key)
             rows.append({"counter": name,

@@ -9,26 +9,25 @@ split through its leaf-contiguous ``order`` array (the reference's
 smaller-child trick, ``serial_tree_learner.cpp:326-404``), so the work per
 split is proportional to the smaller child, not to the dataset:
 
-* ``subset_histogram_fused`` (-> ``pallas_hist.hist6_fused``) — the gen-2
-  rung: the row gather happens INSIDE the Pallas kernel (per-tile DMA of
-  indexed panel rows into VMEM) and the contraction is nibble-factorized,
-  so neither the gathered [M, F] matrix nor the one-hot ever exists in
-  HBM.  Takes the leaf's ``order`` window + offset, not gathered rows.
-* ``pallas_hist.subset_histogram_pallas`` — gen-1 bf16 MXU Pallas kernel
-  over PRE-GATHERED rows; hi/lo-split weights keep ~f32 accuracy (the
-  hardware-proven TPU path, and the fallback when fused is unavailable).
+* ``subset_histogram_fused`` (-> ``pallas_hist.hist6_fused``) — THE Pallas
+  rung: the row gather happens INSIDE the kernel (per-tile DMA of indexed
+  panel rows into VMEM) and the contraction is nibble-factorized, so
+  neither the gathered [M, F] matrix nor the one-hot ever exists in HBM.
+  Takes the leaf's ``order`` window + offset, not gathered rows.
+  ``subset_histogram_fused_local`` is the same rung entered from inside
+  the GSPMD shard_map island (per-shard row -> leaf partition instead of
+  an order window).
 * ``subset_histogram_segment`` — one ``segment_sum`` scatter-add over the
   combined (feature, bin) index; the default CPU path (fallback rungs,
-  test mesh), where scatter lowers well.
+  test mesh), where scatter lowers well.  ``subset_histogram_flat`` is
+  its unchunked GSPMD sibling.
 * ``subset_histogram_einsum`` — chunked f32 one-hot einsum; the
   MXU-shaped debug/parity oracle (``use_pallas=false`` on TPU).
 
-The rung ladder, fastest projected first: fused > pallas > segment/einsum.
-``auto`` still resolves to the hardware-proven ``pallas`` on TPU — the
-fused rung is opt-in (``pallas_fused=on`` / the bench ladder's tpu+fused
-rung) until an on-chip A/B (bench_1m.json vs bench_1m_gen1.json in the
-capture playbook) proves its throughput win, exactly the discipline the
-nibble kernel's ``auto`` follows.
+The ladder is fused vs the XLA reference paths — the gen-1 pre-gathered
+Pallas kernels (onehot/nibble over a staged [M, F] buffer) were retired in
+round 9 when they stopped Mosaic-lowering and the fused kernel subsumed
+their role (see pallas_hist.py).
 
 Each histogram entry is ``(sum_gradients, sum_hessians, count)`` exactly like
 the reference ``HistogramBinEntry`` (``include/LightGBM/bin.h:27-56``).
@@ -79,7 +78,7 @@ def subset_histogram_einsum(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
     f32 one-hot x weights einsum, chunked over rows so the one-hot tensor
     stays small.  This is the CPU / debugging path; the TPU path is the
-    Pallas kernel (``pallas_hist.subset_histogram_pallas``)."""
+    fused Pallas kernel (``pallas_hist.hist6_fused``)."""
     rows = rows.astype(jnp.int32)
     m, f = rows.shape
     b = num_bins
@@ -190,14 +189,14 @@ def subset_histogram_fused(order: jnp.ndarray, panel: jnp.ndarray,
                            num_row_tiles=None,
                            interpret: bool = False,
                            site: str = "split") -> jnp.ndarray:
-    """Gen-2 rung: histogram a leaf's ``order`` window WITHOUT a separate
+    """Fused rung: histogram a leaf's ``order`` window WITHOUT a separate
     gather pass — the kernel DMAs the indexed panel rows itself.
 
     order [NO] i32 (window at [start, start + cnt); see hist6_fused for
     the tail-padding contract), panel [N + 1, W + 3] u32
     (data/packing.py:pack_fused_panel) -> [n_cols, num_bins, 3] f32 with
-    the same (sum_grad, sum_hess, count) layout and the same bf16 hi/lo
-    accuracy contract as the gen-1 pallas path (counts exact)."""
+    the reference (sum_grad, sum_hess, count) layout; gradients/hessians
+    carry the bf16 hi/lo accuracy contract (counts exact)."""
     from .pallas_hist import hist6_fused
     # dispatch-identity evidence (trace-time, per call site): bench rungs
     # and decide_flips verify the label against this counter
@@ -210,44 +209,51 @@ def subset_histogram_fused(order: jnp.ndarray, panel: jnp.ndarray,
     return jnp.stack([h6[0] + h6[1], h6[2] + h6[3], h6[4]], axis=-1)
 
 
+def subset_histogram_fused_local(row_leaf: jnp.ndarray, leaf_id,
+                                 panel: jnp.ndarray, n_cols: int,
+                                 words_per: int, num_bins: int,
+                                 row_tile: int = 512,
+                                 interpret: bool = False,
+                                 site: str = "split") -> jnp.ndarray:
+    """Fused rung, shard-local form for the GSPMD hybrid: the same kernel
+    as :func:`subset_histogram_fused`, but entered from INSIDE a shard_map
+    island where the leaf's membership lives as the row -> leaf partition
+    (``row_leaf``) instead of a maintained order window.
+
+    Returns the [n_cols, num_bins, 3] PARTIAL histogram over this shard's
+    rows matching ``leaf_id``; the caller (parallel/gspmd.py) hands the
+    cross-shard reduction to the SPMD partitioner."""
+    from .pallas_hist import hist6_fused_local
+    # dispatch-identity evidence: under shard_map this traces once for the
+    # whole mesh, same as any other trace-time counter — observed_kernel()
+    # and the census must still attribute the hybrid to the fused kernel
+    obs_counters.inc("hist_dispatch", method="fused", site=site,
+                     interpret=bool(interpret))
+    _maybe_inject_hist_fault("fused", site)
+    h6 = hist6_fused_local(row_leaf, leaf_id, panel, n_cols, words_per,
+                           num_bins, row_tile=row_tile, interpret=interpret)
+    return jnp.stack([h6[0] + h6[1], h6[2] + h6[3], h6[4]], axis=-1)
+
+
 def subset_histogram(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                      c: jnp.ndarray, num_bins: int,
-                     method: str = "auto", feat_tile: int = 8,
-                     row_tile: int = 512, impl: str = "auto",
-                     interpret: bool = False,
+                     method: str = "auto",
                      site: str = "split") -> jnp.ndarray:
-    """Dispatch subset histogram: rows [M, F] int, g/h/c [M] -> [F, B, 3].
+    """Dispatch a PRE-GATHERED subset histogram: rows [M, F] int, g/h/c [M]
+    -> [F, B, 3].
 
-    ``feat_tile``/``row_tile`` shape the Pallas kernel's grid — the analogue
-    of the reference GPU learner's workgroup tuning
-    (gpu_tree_learner.cpp:103-121); ``impl`` picks the kernel formulation
-    (onehot | nibble | auto, see pallas_hist.hist6_pallas); ``interpret``
-    runs the Pallas kernel in interpret mode (CPU-side parity tests).
-
-    ``method="fused"`` resolves to the gen-1 pallas kernel here: this
-    entry point receives PRE-GATHERED rows, and gathered rows have nothing
-    left to fuse — the fused rung enters through
-    :func:`subset_histogram_fused` (the grower calls it with the order
-    window + leaf offset instead of gathering; its root histogram uses
-    the fused kernel too, so only layout-gated fallbacks land here)."""
+    Only the XLA reference formulations live here (segment | einsum |
+    auto): the fused Pallas rung takes an order window or a row -> leaf
+    partition, not gathered rows, so it enters through
+    :func:`subset_histogram_fused` / :func:`subset_histogram_fused_local`
+    — by the time rows are gathered there is nothing left to fuse."""
     if method == "auto":
-        # hardware-proven default; the fused rung stays opt-in until the
-        # on-chip A/B flips it (module docstring)
-        method = "pallas" if on_tpu() else "segment"
+        method = "segment"
     # the RESOLVED method, per call site — trace-time counts that the
-    # rung-honesty checks (bench.py / decide_flips.py) read back; a
-    # pre-gathered "fused" request lands on the gen-1 pallas kernel, so
-    # it is recorded as pallas (the identity that actually runs)
-    obs_counters.inc("hist_dispatch",
-                     method=("pallas" if method == "fused" else method),
-                     site=site, interpret=bool(interpret))
+    # rung-honesty checks (bench.py / decide_flips.py) read back
+    obs_counters.inc("hist_dispatch", method=method, site=site,
+                     interpret=False)
     _maybe_inject_hist_fault(method, site)
-    if method in ("pallas", "fused"):
-        from .pallas_hist import subset_histogram_pallas
-        return subset_histogram_pallas(rows, g, h, c, num_bins,
-                                       feat_tile=feat_tile,
-                                       row_tile=row_tile, impl=impl,
-                                       interpret=interpret)
     if method == "einsum":
         return subset_histogram_einsum(rows, g, h, c, num_bins)
     if method == "segment":

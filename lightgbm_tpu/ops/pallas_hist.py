@@ -1,30 +1,27 @@
-"""Pallas TPU histogram kernel.
+"""Pallas TPU histogram kernel: the fused-gather, nibble-factorized form.
 
 The TPU answer to the reference's OpenCL histogram kernels
 (``src/treelearner/ocl/histogram256.cl`` — per-workgroup local-memory
-histograms with hand-rolled atomic float adds): instead of scatter-adds,
-each grid step builds a one-hot of the combined (feature, bin) index for a
-row tile *in VMEM* and contracts it against the per-row weight channels on
-the MXU.  The [rows, features*bins] one-hot never exists in HBM — only the
-[feature_tile * B] accumulator block does, revisited across row tiles.
+histograms with hand-rolled atomic float adds).  TPUs have no fast random
+scatter, so the native formulation is a one-hot x weights contraction on
+the MXU — and this module holds the one kernel that survived two
+generations of that idea: ``hist6_fused``, which DMAs the leaf's indexed
+panel rows into VMEM itself (no separate gather pass, no staging buffer)
+and contracts through the hi/lo nibble factorization.
 
-Layout: bins come in transposed ``[F, N]`` so the row dimension is the lane
-axis of each block.  Weights ``w_t [6, N]`` carry the bf16 channels
-``(g_hi, g_lo, h_hi, h_lo, c, 0)`` — gradients/hessians are hi/lo-split so a
-single-pass bf16 MXU dot accumulates with ~f32 accuracy (recombined by the
-caller, ``subset_histogram_pallas``).
+The gen-1 kernels (a combined-index one-hot dot and a standalone nibble
+form, both over PRE-GATHERED ``[M, F]`` rows) lived here until round 9.
+They stopped Mosaic-lowering on the current jax/libtpu (the quarantine
+that used to sit in tests/test_mosaic_aot.py), the fused kernel subsumed
+both their roles, and they were deleted — the dispatch ladder is now
+fused vs the XLA reference paths (ops/histogram.py).  Their hard-won
+Mosaic lessons survive as the fused kernel's design notes below.
 
-Mosaic constraints shape two choices here (round-2 lesson: the kernel failed
-`infer-vector-layout: unsupported shape cast` on a `vector<512x8x255xi1>`
-reshape):
-
-* the per-bin axis is padded up to a multiple of the 128-wide lane register
-  (255 -> 256) so every reshape keeps the lane dimension aligned; the caller
-  slices the phantom bins off (they are provably zero: bin ids < num_bins);
-* the boolean one-hot is cast to the matmul dtype *before* the
-  [TR, TF, B] -> [TR, TF*B] collapse, so Mosaic never has to lay out an i1
-  vector across a shape cast — and the kernel's output block stays 2D
-  ([6, TF*B]); the reshape to [6, F, B] happens outside Pallas in XLA.
+``hist6_fused_local`` is the shard-local entry for the GSPMD hybrid
+(parallel/gspmd.py): inside a ``shard_map`` island it derives the leaf's
+LOCAL order window from the row->leaf partition and runs the same kernel
+over the device's row shard — one kernel from laptop CPU (interpret mode)
+to pod slice.
 """
 from __future__ import annotations
 
@@ -36,229 +33,31 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..obs.counters import counters as obs_counters
-from ..utils import log
 from .pallas_compat import CompilerParams, MemorySpace
 
 NUM_CH = 6   # weight channels: (g_hi, g_lo, h_hi, h_lo, c, unused)
 LANES = 128  # TPU vector register lane width — bin axis is padded to this
-# warn-once registry for the nibble fallback, keyed by the unsupported
-# histogram width: a second model in the same process with a DIFFERENT
-# unsupported width must still warn (a bare process-global bool silently
-# suppressed it), while the grower's dozen-plus traces of one model at one
-# width still produce a single line.
-_nibble_warned_widths: set = set()
-
-
-def _hist_kernel(bins_ref, w_ref, out_ref, *, num_bins: int, feat_tile: int):
-    r = pl.program_id(1)
-
-    @pl.when(r == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    bins = bins_ref[...].astype(jnp.int32)          # [TF, TR]
-    w = w_ref[...]                                  # [6, TR]
-    tr = bins.shape[1]
-    # one-hot of the bin index per (row, feature-in-tile): [TR, TF, B];
-    # flattened over (feature, bin) it is the combined-index one-hot.
-    # num_bins is lane-aligned and the cast precedes the collapse (see
-    # module docstring for the Mosaic rationale).
-    onehot = (bins.T[:, :, None] ==
-              lax.broadcasted_iota(jnp.int32, (tr, feat_tile, num_bins), 2)
-              ).astype(w.dtype)
-    onehot2d = onehot.reshape(tr, feat_tile * num_bins)
-    # channels on the SUBLANE axis: [6, TR] @ [TR, TF*B] pads 6 -> 8 rows
-    # instead of 6 -> 128 lanes (16x less MXU waste than the transposed form)
-    out_ref[...] += jnp.dot(w, onehot2d,
-                            preferred_element_type=jnp.float32)  # [6, TF*B]
-
-
 NIB = 16     # nibble radix: bin = hi*16 + lo, each one-hot 16 wide
 
 
-def _hist_kernel_nibble(bins_ref, w_ref, out_ref, *, feat_tile: int):
-    """Nibble-factorized histogram block: bin = hi*16 + lo.
-
-    The plain one-hot kernel's dot is [6, TR] @ [TR, TF*256]; on the MXU
-    the 6-channel M dim pads to 128, so the slot cost per row is
-    128 * 256 lanes per feature.  Factoring the one-hot through the two
-    nibbles moves the hi one-hot INTO the M dim — U = (channel x hi_onehot)
-    is 96 rows, padding 128 with only 1.3x waste — and shrinks the lane
-    side to the 16-wide lo one-hot (padded to the 128 floor): per row per
-    feature 128 * 128 slots, half the plain kernel, and ~3x less VPU work
-    building one-hots (2x16 instead of 256 compares+casts).  Only pays
-    when B_pad = 256, i.e. num_bins > 128; below that the plain kernel
-    already sits on the 128-lane floor.
-
-    Output block [96, TF*16]: rows are (ch, hi) ch-major, columns (f, lo);
-    the lane dim is exactly 128 at feat_tile=8 so no kernel-side reshape
-    ever crosses the lane boundary (the round-2 Mosaic lesson); the
-    unfold to [6, F, 256] happens outside in XLA."""
-    r = pl.program_id(1)
-
-    @pl.when(r == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    bins = bins_ref[...].astype(jnp.int32)          # [TF, TR]
-    w = w_ref[...]                                  # [6, TR]
-    tr = bins.shape[1]
-    hi = bins >> 4                                  # [TF, TR], < 16
-    lo = bins & 15
-    # per-feature [96, 16] dots are CONCATENATED along lanes and stored
-    # once as the full [96, TF*16] block: sub-lane-width (16 < 128) slice
-    # writes into out_ref are the kind of masked partial store Mosaic has
-    # historically mislowered, so the kernel never does one
-    blocks = []
-    for f in range(feat_tile):
-        oh_hi = (hi[f][None, :] ==
-                 lax.broadcasted_iota(jnp.int32, (NIB, tr), 0)
-                 ).astype(w.dtype)                  # [16, TR]
-        u = (w[:, None, :] * oh_hi[None, :, :]).reshape(NUM_CH * NIB, tr)
-        oh_lo = (lo[f][:, None] ==
-                 lax.broadcasted_iota(jnp.int32, (tr, NIB), 1)
-                 ).astype(w.dtype)                  # [TR, 16]
-        blocks.append(jnp.dot(u, oh_lo,
-                              preferred_element_type=jnp.float32))  # [96,16]
-    out_ref[...] += jnp.concatenate(blocks, axis=1)   # [96, TF*16]
-
-
-def hist6_pallas(bins_t: jnp.ndarray, w_t: jnp.ndarray, num_bins: int,
-                 feat_tile: int = 8, row_tile: int = 512,
-                 interpret: bool = False, impl: str = "auto") -> jnp.ndarray:
-    """bins_t: [F, N] int; w_t: [6, N] f32 -> hist [6, F, B] f32.
-
-    F must be a multiple of feat_tile and N of row_tile (pad at the caller;
-    padded rows must carry w = 0, padded features are sliced off).
-
-    ``impl``: 'onehot' (single combined-index one-hot dot), 'nibble'
-    (hi/lo factorized, B_pad = 256 only), or 'auto' — which currently
-    resolves to 'onehot' unconditionally: the nibble form is the
-    projected winner at B_pad = 256 but stays opt-in until the on-chip
-    tier (test_pallas_nibble_*) proves its Mosaic lowering.
-    """
-    f, n = bins_t.shape
-    assert f % feat_tile == 0 and n % row_tile == 0, (f, n, feat_tile, row_tile)
-    b_pad = -(-num_bins // LANES) * LANES
-    grid = (f // feat_tile, n // row_tile)
-    if impl == "auto":
-        # the nibble form is the projected 2x winner at B_pad = 256; its
-        # Mosaic LOWERING is proven offline (tests/test_mosaic_aot.py AOT-
-        # compiles it for v5e), but 'auto' stays on the hardware-proven
-        # kernel until an on-chip A/B confirms the throughput win
-        # (bench_1m_nibble.json in the capture playbook — then flip here)
-        impl = "onehot"
-    if impl == "nibble" and b_pad != 2 * LANES:
-        # the config gate is optimistic about bin packing widening the
-        # axis to 256; when no pack plan materialized the effective width
-        # stays < 129 and the factorization has nothing to win — fall
-        # back instead of tripping the shape assert inside tracing.
-        # Warn once per WIDTH: the grower traces one call per gather
-        # bucket, which would repeat the identical line a dozen-plus times
-        # — but a second model with a different unsupported width still
-        # warns (the A/B harness must never silently mislabel a run)
-        if num_bins not in _nibble_warned_widths:
-            _nibble_warned_widths.add(num_bins)
-            log.warning("pallas_hist_impl=nibble needs a 256-wide histogram "
-                        "axis (got %d bins); using the one-hot kernel",
-                        num_bins)
-            obs_counters.event("layout_downgrade", stage="pallas_hist",
-                               requested="nibble", resolved="onehot",
-                               reason=f"histogram axis pads to {b_pad}, "
-                                      "nibble needs 256")
-        impl = "onehot"
-    # resolved kernel FORM (onehot vs nibble) — the fine-grained identity
-    # under hist_dispatch's method=pallas (trace-time, per call site)
-    obs_counters.inc("pallas_impl", impl=impl)
-    if impl == "nibble":
-        assert b_pad == 2 * LANES and (feat_tile * NIB) % LANES == 0, \
-            (num_bins, feat_tile)
-        out2d = pl.pallas_call(
-            functools.partial(_hist_kernel_nibble, feat_tile=feat_tile),
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((feat_tile, row_tile), lambda fi, ri: (fi, ri)),
-                pl.BlockSpec((NUM_CH, row_tile), lambda fi, ri: (0, ri)),
-            ],
-            out_specs=pl.BlockSpec((NUM_CH * NIB, feat_tile * NIB),
-                                   lambda fi, ri: (0, fi)),
-            out_shape=jax.ShapeDtypeStruct((NUM_CH * NIB, f * NIB),
-                                           jnp.float32),
-            interpret=interpret,
-        )(bins_t, w_t)
-        # [(ch, hi), (f, lo)] -> [ch, f, hi*16+lo], all in XLA
-        out4 = out2d.reshape(NUM_CH, NIB, f, NIB)
-        return out4.transpose(0, 2, 1, 3).reshape(
-            NUM_CH, f, NIB * NIB)[:, :, :num_bins]
-    out2d = pl.pallas_call(
-        functools.partial(_hist_kernel, num_bins=b_pad,
-                          feat_tile=feat_tile),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((feat_tile, row_tile), lambda fi, ri: (fi, ri)),
-            pl.BlockSpec((NUM_CH, row_tile), lambda fi, ri: (0, ri)),
-        ],
-        out_specs=pl.BlockSpec((NUM_CH, feat_tile * b_pad),
-                               lambda fi, ri: (0, fi)),
-        out_shape=jax.ShapeDtypeStruct((NUM_CH, f * b_pad), jnp.float32),
-        interpret=interpret,
-    )(bins_t, w_t)
-    # un-flatten and drop the lane-padding bins outside the kernel (plain XLA)
-    return out2d.reshape(NUM_CH, f, b_pad)[:, :, :num_bins]
-
-
-def subset_histogram_pallas(rows: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
-                            c: jnp.ndarray, num_bins: int,
-                            feat_tile: int = 8, row_tile: int = 512,
-                            interpret: bool = False,
-                            impl: str = "auto") -> jnp.ndarray:
-    """Histogram of a gathered row subset: rows [M, F] int, g/h/c [M] f32
-    (0 for padding rows) -> [F, B, 3].
-
-    Single-pass bf16 MXU matmul with hi/lo-split weights for ~f32 accuracy:
-    channels are (g_hi, g_lo, h_hi, h_lo, c, 0); the f32 histogram is
-    recombined as hi + lo after the f32-accumulated dot."""
-    from .histogram import _split_hi_lo
-    m, f = rows.shape
-    g_hi, g_lo = _split_hi_lo(g.astype(jnp.float32))
-    h_hi, h_lo = _split_hi_lo(h.astype(jnp.float32))
-    w_t = jnp.stack([g_hi, g_lo, h_hi, h_lo,
-                     c.astype(jnp.bfloat16),
-                     jnp.zeros_like(c, jnp.bfloat16)], axis=0)   # [6, M] bf16
-    bins_t = rows.astype(jnp.int32).T                            # [F, M]
-    pad_f = (-f) % feat_tile
-    pad_m = (-m) % row_tile
-    if pad_f:
-        bins_t = jnp.pad(bins_t, ((0, pad_f), (0, 0)))
-    if pad_m:
-        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad_m)))
-        w_t = jnp.pad(w_t, ((0, 0), (0, pad_m)))
-    hist6 = hist6_pallas(bins_t, w_t, num_bins, feat_tile, row_tile,
-                         interpret=interpret, impl=impl)[:, :f]  # [6, F, B]
-    hist_g = hist6[0] + hist6[1]
-    hist_h = hist6[2] + hist6[3]
-    return jnp.stack([hist_g, hist_h, hist6[4]], axis=-1)        # [F, B, 3]
-
-
 # ---------------------------------------------------------------------------
-# Generation 2: fused-gather, nibble-factorized histogram kernel.
+# The fused-gather, nibble-factorized histogram kernel.
 #
-# The gen-1 path pays two separately-measured costs per split (docs/PERF.md
-# cost model): a random row gather through XLA (~12.6 ns/elem, staged into a
-# pow2-padded [M, F] HBM buffer) and the one-hot MXU contraction whose
-# 6-channel M dim pads to 128 (~21x slot waste).  This kernel is the same
-# move the reference made when it fused gather+accumulate into one OpenCL
-# pass (src/treelearner/ocl/histogram256.cl): the row gather happens INSIDE
-# the kernel — per-tile, the window of the leaf's ``order`` indices is DMAd
-# into SMEM and each indexed panel row is DMAd from HBM straight into VMEM,
-# so the gathered [M, F] matrix never exists in HBM and the separate gather
-# dispatch disappears — and the contraction is the nibble-factorized form
-# (bin = hi*16 + lo, M = ch x hi = 96 rows, 16-wide lo one-hot) that cuts
-# the MXU slot cost ~2x at B_pad = 256.  PERF.md projects the stack at
+# The retired gen-1 path paid two separately-measured costs per split
+# (docs/PERF.md cost model): a random row gather through XLA (~12.6 ns/elem,
+# staged into a pow2-padded [M, F] HBM buffer) and a one-hot MXU contraction
+# whose 6-channel M dim padded to 128 (~21x slot waste).  This kernel is the
+# same move the reference made when it fused gather+accumulate into one
+# OpenCL pass (src/treelearner/ocl/histogram256.cl): the row gather happens
+# INSIDE the kernel — per-tile, the window of the leaf's ``order`` indices is
+# DMAd into SMEM and each indexed panel row is DMAd from HBM straight into
+# VMEM, so the gathered [M, F] matrix never exists in HBM and the separate
+# gather dispatch disappears — and the contraction is the nibble-factorized
+# form (bin = hi*16 + lo, M = ch x hi = 96 rows, 16-wide lo one-hot) that
+# cuts the MXU slot cost ~2x at B_pad = 256.  PERF.md projects the stack at
 # ~8.5 ns/row vs the measured 22 + 12.6.
 #
-# Three structural differences from the gen-1 kernels:
+# Three structural points:
 #
 # * the input is the FUSED PANEL (data/packing.py:pack_fused_panel): packed
 #   bin words + the three bitcast f32 weight columns in one u32 row, so the
@@ -361,7 +160,7 @@ def _hist_kernel_fused(sc_ref, order_ref, panel_ref, out_ref,
     wmask = jnp.uint32((1 << shift) - 1)
 
     # on-chip hi/lo weight split (the _split_hi_lo contract): channels
-    # (g_hi, g_lo, h_hi, h_lo, c, 0) exactly like subset_histogram_pallas.
+    # (g_hi, g_lo, h_hi, h_lo, c, 0), the retired gen-1 kernels' layout.
     # NO bf16 values exist below full-tile width: Mosaic rejected both the
     # gen-1 nibble form's [6, 1, TR] broadcast-multiply (vector.shape_cast)
     # and a [1, TR] bf16 sublane broadcast (vector.broadcast) — bf16's
@@ -476,7 +275,45 @@ def hist6_fused(order: jnp.ndarray, panel: jnp.ndarray, start, cnt,
         compiler_params=CompilerParams(dimension_semantics=("arbitrary",)),
     )(sc, order, panel)
     # [(ch, hi), (f, lo)] -> [ch, f, hi*16+lo], all in XLA (the same
-    # epilogue as the gen-1 nibble form)
+    # epilogue the retired gen-1 nibble form used)
     out4 = out2d.reshape(NUM_CH, NIB, n_cols_pad, NIB)
     return out4.transpose(0, 2, 1, 3).reshape(
         NUM_CH, n_cols_pad, NIB * NIB)[:, :n_cols, :num_bins]
+
+
+def hist6_fused_local(row_leaf: jnp.ndarray, leaf_id, panel: jnp.ndarray,
+                      n_cols: int, words_per: int, num_bins: int,
+                      row_tile: int = 512,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Shard-local fused histogram for the GSPMD hybrid: derive the leaf's
+    LOCAL order window from the row -> leaf partition, then run the same
+    ``hist6_fused`` kernel over this device's row shard.
+
+    row_leaf [n_loc] i32 (this shard's row -> leaf ids), leaf_id traced i32
+    scalar, panel the shard's pack_fused_panel output (sentinel row
+    appended by the caller before packing) -> [6, n_cols, num_bins] f32
+    partial histogram (sums over the local rows only; the caller reduces
+    across shards).
+
+    The serial grower keeps ``order`` incrementally via its partition
+    switch; under GSPMD the row -> leaf map IS the state, so the window is
+    rebuilt per call with a cumsum compaction — O(n_loc) work, and the
+    kernel's dynamic grid still makes the gather cost leaf-sized
+    (ceil(cnt / row_tile) tiles, not n_loc / row_tile).
+    """
+    n_loc = row_leaf.shape[0]
+    match = row_leaf == jnp.asarray(leaf_id, row_leaf.dtype)
+    pos = jnp.cumsum(match.astype(jnp.int32)) - 1      # rank among matches
+    cnt = jnp.sum(match.astype(jnp.int32))
+    tail = fused_idx_fetch(row_tile)
+    # compaction scatter: matching rows land at their rank, the rest are
+    # routed out of bounds and dropped.  The tail (and any slot past cnt)
+    # is never USED — the kernel redirects positions >= cnt to the panel's
+    # sentinel row — it only has to exist for the aligned over-fetch.
+    order = jnp.full((n_loc + tail,), n_loc, jnp.int32)
+    order = order.at[jnp.where(match, pos, n_loc + tail)].set(
+        jnp.arange(n_loc, dtype=jnp.int32), mode="drop")
+    num_row_tiles = jnp.maximum(1, -(-cnt // row_tile)).astype(jnp.int32)
+    return hist6_fused(order, panel, 0, cnt, n_cols, words_per, num_bins,
+                       row_tile=row_tile, num_row_tiles=num_row_tiles,
+                       interpret=interpret)

@@ -28,7 +28,7 @@ GSPMD — a data-dependent window slice of a sharded carrier would force
 the partitioner to materialize the global array.  The partition is
 instead the direct row->leaf map: routing a split is one elementwise
 update of ``row_leaf`` (collective-free — every row's bin is local), and
-the smaller child's histogram masks on ``row_leaf == child`` over all
+the smaller child's histogram selects on ``row_leaf == child`` over all
 local rows.  Per-device split cost is O(rows/shard) instead of the
 serial path's O(window) — the trade the reference's data-parallel
 learner also makes (each worker scans its whole partition), bought back
@@ -37,6 +37,24 @@ the serial grower's exact helpers (``route_goes_left`` / ``best_split``
 / ``pool_rows`` / ``unpack_tree``), so trees are the SAME trees —
 byte-identical under order-insensitive (integer) weights, pinned across
 mesh shapes in tests/test_gspmd.py.
+
+The HISTOGRAM itself has two formulations under the same program shape
+(``gspmd_hist``, resolved in ``boosting._setup_gspmd``):
+
+* ``flat`` — the masked whole-partition scatter-add
+  (``subset_histogram_flat``): pure XLA, partitions on any layout, and
+  the forced A/B partner;
+* ``fused`` — the hybrid: a ``shard_map`` manual-sharding ISLAND inside
+  the same jit'd program, in which each device runs the fused Pallas
+  gather-histogram (``ops/pallas_hist.hist6_fused``) over its own row
+  shard of the packed ``pack_fused_panel`` layout.  Mosaic owns the
+  inside of the island (per-shard index compaction + in-kernel row
+  DMAs); the SPMD partitioner still owns everything OUTSIDE it — the
+  island returns per-device feature-sliced partials and the cross-shard
+  reduction into the feature-sharded pool is the partitioner's, with
+  the same shard-sized payload the flat path gets (pinned via the HLO
+  census: no all-gather of row shards, ever).  One kernel from laptop
+  CPU (``hist_interpret=True``) to pod slice.
 
 ``parallel/sync.py``'s hardened host-object ladder stays the
 control-plane (bin finding, checkpoint barriers, preemption agreement):
@@ -53,19 +71,22 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..data.packing import PACK_JOINT_BINS, unfold_packed_hist
+from ..data.packing import (PACK_JOINT_BINS, pack_fused_panel,
+                            unfold_packed_hist)
 from ..grower import (FeatureMeta, GrowerConfig, _depth_gate,
                       expand_bundle_hist, make_expand_maps, pool_rows,
                       route_goes_left, unpack_tree)
 from ..obs import trace as obs_trace
 from ..obs.counters import counters as obs_counters
-from ..ops.histogram import subset_histogram_flat
+from ..ops.histogram import subset_histogram_flat, subset_histogram_fused_local
 from ..ops.split import best_split, leaf_output, make_fused_ctx
+from .learner import _CHECK_KW, shard_map
 from .mesh import BATCH_AXIS, FEATURE_AXIS
 
 
 def make_gspmd_grower(cfg: GrowerConfig, mesh: Mesh,
-                      bundled: bool = False, pack_plan=None) -> Callable:
+                      bundled: bool = False, pack_plan=None,
+                      block_shard: bool = False) -> Callable:
     """Build the jitted GSPMD ``grow_tree`` over global arrays.
 
     Same call signature as ``make_grower``'s product — ``fn(bins,
@@ -74,20 +95,30 @@ def make_gspmd_grower(cfg: GrowerConfig, mesh: Mesh,
     ``NamedSharding(mesh, ...)`` (uncommitted inputs are resharded by the
     first call).  ``row_leaf`` comes back row-sharded on ``batch``.
 
-    The histogram method is always the flat scatter-add
-    (``subset_histogram_flat``): the Pallas kernels are manual-layout
-    custom calls the SPMD partitioner cannot split, and the scan-chunked
-    forms make it all-gather the row shards (module docstring) — the
-    caller (``boosting._setup_grower``) downgrades any other request
-    loudly before this builder runs.
+    The histogram formulation follows ``cfg.hist_method``: ``"fused"``
+    builds the shard_map hybrid (module docstring) — the fused Pallas
+    kernel is a manual-layout custom call the SPMD partitioner cannot
+    split, so it runs INSIDE a manual-sharding island over per-shard
+    locals, and only its per-device partial sums re-enter partitioner
+    territory.  Any other value runs the flat scatter-add
+    (``subset_histogram_flat``; the scan-chunked forms make the
+    partitioner all-gather the row shards, and unfusable layouts are
+    downgraded loudly by ``boosting._setup_gspmd`` before this builder
+    runs — by then the request is always fused or flat).
     """
     L = cfg.num_leaves
     hist_width = (max(PACK_JOINT_BINS, cfg.max_bin) if pack_plan is not None
                   else cfg.max_bin)
     shard_hist = int(mesh.shape[FEATURE_AXIS]) > 1
+    f_shards = int(mesh.shape[FEATURE_AXIS])
+    use_fused = cfg.hist_method == "fused"
 
     def cstr(x, spec):
         return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    def smap(fn, in_specs, out_specs):
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **{_CHECK_KW: False})
 
     def grow_impl(bins, hist_src, gw, hw, cw, meta: FeatureMeta,
                   feat_valid):
@@ -117,13 +148,92 @@ def make_gspmd_grower(cfg: GrowerConfig, mesh: Mesh,
                                   is_cat=meta.is_categorical,
                                   with_feat_ok=True, fused_ctx=fctx)
 
-        def measure(g_, h_, c_, site):
-            """Masked whole-partition histogram: the sum over the row
-            axis IS the collective — with the feature-sharded output
+        # ---- fused island: per-shard panel, packed once per grow --------
+        # loop-invariant (weights are per-tree constants: the fused kernel
+        # selects leaf membership through the row -> leaf partition, not
+        # through masked weights), so XLA hoists it out of the while loop.
+        # in_specs reshard hist_src's feature axis even when the global
+        # carrier is feature-replicated: each device packs only ITS column
+        # slice (f-way compute parallelism, and the island's partials stay
+        # slice-sized — a local slice, never a collective).
+        panel = None
+        if use_fused:
+            sc_cols = hist_src.shape[1]
+            # layout gates live in boosting._setup_gspmd (loudly, before
+            # labels are read); by trace time they must all hold
+            assert sc_cols % f_shards == 0, (sc_cols, f_shards)
+            fcols_loc = sc_cols // f_shards
+            words_per = 4 if hist_src.dtype.itemsize == 1 else 2
+            panel_fspec = FEATURE_AXIS if f_shards > 1 else None
+            # Pin the GLOBAL carriers to their caller placements before the
+            # island sees them.  Without the pin the island's
+            # feature-sharded in_spec wins the sharding-propagation
+            # argument and bins goes feature-sharded program-wide — then
+            # routing's dynamic column read inside the while body
+            # re-gathers a full row shard EVERY split, exactly the
+            # collective the hybrid exists to avoid (the HLO census test
+            # pins its absence).  With the pin the reshard is a one-time
+            # local slice at the island boundary.
+            bins = cstr(bins, P(BATCH_AXIS,
+                                FEATURE_AXIS if block_shard else None))
+            if pack_plan is None:
+                hist_src = bins
+            else:
+                # the packed histogram matrix is always placed
+                # feature-replicated by boosting (P(batch, None))
+                hist_src = cstr(hist_src, P(BATCH_AXIS, None))
+
+            def pack_island(bins_loc, g_loc, h_loc, c_loc):
+                zrow = jnp.zeros((1, bins_loc.shape[1]), bins_loc.dtype)
+                zw = jnp.zeros((1,), g_loc.dtype)
+                p, _ = pack_fused_panel(
+                    jnp.concatenate([bins_loc, zrow], axis=0),
+                    jnp.concatenate([g_loc, zw]),
+                    jnp.concatenate([h_loc, zw]),
+                    jnp.concatenate([c_loc, zw]))
+                return p
+
+            with jax.named_scope("fused_panel"):
+                panel = smap(
+                    pack_island,
+                    in_specs=(P(BATCH_AXIS, panel_fspec), P(BATCH_AXIS),
+                              P(BATCH_AXIS), P(BATCH_AXIS)),
+                    out_specs=P(BATCH_AXIS, panel_fspec),
+                )(hist_src, gw, hw, cw)
+
+        def measure(row_leaf_cur, leaf_id, g_, h_, c_, site):
+            """One leaf histogram, both formulations.
+
+            flat: masked whole-partition scatter-add — the sum over the
+            row axis IS the collective; with the feature-sharded output
             constraint each device reduces only its own slice and XLA
-            inserts the shard-sized cross-batch reduction."""
-            hist = subset_histogram_flat(hist_src, g_, h_, c_, hist_width,
-                                         site=site)
+            inserts the shard-sized cross-batch reduction.
+
+            fused: shard_map island — each device compacts its local
+            ``row_leaf == leaf`` rows and runs the fused Pallas
+            gather-histogram over its panel slice; the island returns
+            [d, C/f, B, 3] per-device partials and the ``sum(axis=0)``
+            OUTSIDE the island hands the partitioner the exact same
+            shard-sized cross-batch reduction (never an all-gather of row
+            shards — pinned by the HLO census)."""
+            if use_fused:
+                def hist_island(panel_loc, rl_loc, leaf_loc):
+                    part = subset_histogram_fused_local(
+                        rl_loc, leaf_loc, panel_loc, fcols_loc, words_per,
+                        hist_width, row_tile=cfg.row_tile,
+                        interpret=cfg.hist_interpret, site=site)
+                    return part[None]
+
+                part = smap(
+                    hist_island,
+                    in_specs=(P(BATCH_AXIS, panel_fspec), P(BATCH_AXIS),
+                              P()),
+                    out_specs=P(BATCH_AXIS, panel_fspec, None, None),
+                )(panel, row_leaf_cur, jnp.asarray(leaf_id, jnp.int32))
+                hist = jnp.sum(part, axis=0)
+            else:
+                hist = subset_histogram_flat(hist_src, g_, h_, c_,
+                                             hist_width, site=site)
             if pack_plan is not None:
                 hist = unfold_packed_hist(hist, pack_plan, cfg.max_bin)
             return cstr(hist, P(FEATURE_AXIS if shard_hist else None,
@@ -134,9 +244,11 @@ def make_gspmd_grower(cfg: GrowerConfig, mesh: Mesh,
         root_h = jnp.sum(hw)
         root_c = jnp.sum(cw)
         feat_ok_all = jnp.ones((num_logical,), bool)
+        row_leaf0 = cstr(jnp.zeros((n,), jnp.int32), P(BATCH_AXIS))
         with tracer.span("histogram", site="root", traced=True), \
                 jax.named_scope("histogram"):
-            hist_root = measure(gw, hw, cw, site="root")
+            hist_root = measure(row_leaf0, jnp.asarray(0, jnp.int32),
+                                gw, hw, cw, site="root")
         res_root, root_feat_ok = find(hist_root, root_g, root_h, root_c,
                                       feat_ok_all)
         res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
@@ -168,7 +280,6 @@ def make_gspmd_grower(cfg: GrowerConfig, mesh: Mesh,
         tlf0 = jnp.zeros((L, 2), dtype).at[0, 1].set(root_c)
         tli0 = jnp.concatenate([jnp.full((L, 1), -1, jnp.int32),
                                 jnp.zeros((L, 1), jnp.int32)], axis=1)
-        row_leaf0 = cstr(jnp.zeros((n,), jnp.int32), P(BATCH_AXIS))
 
         def cond(state):
             step = state[0]
@@ -242,11 +353,15 @@ def make_gspmd_grower(cfg: GrowerConfig, mesh: Mesh,
             # --- smaller-child histogram + parent subtraction ------------
             small_left = frow[2] <= frow[5]
             small_id = jnp.where(small_left, l, new_leaf)
-            mask = (row_leaf == small_id).astype(dtype)
             with tracer.span("histogram", site="split", traced=True), \
                     jax.named_scope("histogram"):
-                hist_small = measure(gw * mask, hw * mask, cw * mask,
-                                     site="split")
+                if use_fused:
+                    hist_small = measure(row_leaf, small_id, gw, hw, cw,
+                                         site="split")
+                else:
+                    mask = (row_leaf == small_id).astype(dtype)
+                    hist_small = measure(row_leaf, small_id, gw * mask,
+                                         hw * mask, cw * mask, site="split")
             hist_parent = lax.dynamic_index_in_dim(hist_store, l, axis=0,
                                                    keepdims=False)
             hist_large = hist_parent - hist_small

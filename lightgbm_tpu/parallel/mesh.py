@@ -109,7 +109,7 @@ def plan_mesh(n_devices: int, rows: int, features: int, bins: int = 255,
               leaves: int = 31, num_class: int = 1,
               bin_bytes: Optional[int] = None, packed_cols: int = 0,
               valid_rows: int = 0, capacity: Optional[int] = None,
-              prefer: str = "data") -> MeshPlan:
+              prefer: str = "data", gspmd_fused: bool = False) -> MeshPlan:
     """The memory-driven sharding planner (``mesh_shape=auto``).
 
     Evaluates ``obs/memory.predict_hbm`` per candidate ``(data,
@@ -142,7 +142,8 @@ def plan_mesh(n_devices: int, rows: int, features: int, bins: int = 255,
                         leaves=leaves, num_class=num_class,
                         bin_bytes=bin_bytes, packed_cols=packed_cols,
                         valid_rows=valid_rows, data_shards=d,
-                        feature_shards=f, block_shard_bins=block)
+                        feature_shards=f, block_shard_bins=block,
+                        gspmd_fused=gspmd_fused)
         comps = dict(sorted({**p["residents"], **p["transients"]}.items(),
                             key=lambda kv: -kv[1])[:4])
         return int(p["peak_bytes"]), comps

@@ -7,8 +7,10 @@ criterion.  Decisions require clean TPU numbers on BOTH sides — degraded
 or CPU-fallback artifacts never decide a TPU default, and an artifact
 whose telemetry-observed kernel identity (bench.py's "telemetry" block,
 the lightgbm_tpu.obs dispatch counters) disagrees with its rung label is
-rejected the same way: a tpu+pallas rung that actually ran einsum must
-never decide anything.  Decisions still
+rejected the same way: a tpu+fused rung that actually ran einsum must
+never decide anything.  A stage that died (timeout, tunnel drop) leaves a
+structured ``probe_failed`` artifact instead of an empty file — rendered
+here as a FAILED row, never mistaken for "not captured".  Decisions still
 land as code edits (boosting.py auto-resolution block) — this script only
 reads.
 
@@ -40,11 +42,11 @@ def _load_obs_diff():
 FLIPS = [
     # INVERTED pair: the headline bench_1m.json is the tpu+fused number
     # (the default ladder tries fused first), so this artifact is the
-    # forced gen-1 side — LOSE here means the fused kernel won and
+    # forced-XLA side — LOSE here means the fused kernel won and
     # pallas_fused flips auto->on in config.py/boosting.py
-    ("bench_1m_gen1.json", "BENCH_FUSED=0 (gen-1 kernel forced)",
+    ("bench_1m_xla.json", "BENCH_FUSED=0 (XLA einsum rung forced)",
      "if this LOSES >=5% to the headline, flip pallas_fused auto->on "
-     "(config.py) — the gen-2 fused kernel becomes the TPU default", None),
+     "(config.py) — the fused kernel becomes the TPU default", None),
     ("bench_1m_ordered_sort.json", "ordered_bins=on + partition_impl=sort",
      "flip BOTH autos in boosting.py", None),
     ("bench_1m_compact.json", "partition_impl=compact",
@@ -59,9 +61,6 @@ FLIPS = [
      "gather_words auto->off on TPU if OFF wins (panel rides words)", None),
     ("bench_1m_nopanel.json", "gather_panel=off",
      "keep gather_panel auto-on unless OFF wins", None),
-    ("bench_1m_nibble.json", "pallas_hist_impl=nibble",
-     "hist6_pallas 'auto' -> nibble at B_pad=256 (ops/pallas_hist.py)",
-     None),
     ("bench_1m_pow15.json", "bucket_scheme=pow15",
      "bucket_scheme auto->pow15", None),
     ("bench_sparse_nopack.json", "enable_bin_packing=false",
@@ -79,7 +78,7 @@ FLIPS = [
 COVERAGE = ["bench_1m_63bin.json", "bench_higgs_full.json",
             "bench_wide.json", "bench_sparse.json", "bench_leaves.json",
             "bench_leaves_fused.json", "bench_serving.json",
-            "bench_mesh.json"]
+            "bench_mesh.json", "bench_mesh_fused.json"]
 # scripts/obs_diff.py thresholds for the in-pair drift annotations (the
 # same defaults the CLI uses)
 _DIFF_THRESHOLDS = {"throughput_pct": 10.0, "latency_pct": 25.0,
@@ -192,39 +191,65 @@ def serving_row(d):
 
 
 def mesh_rows(d):
-    """Per-shape lines for the mesh rung's shard_map-vs-GSPMD A/B
-    (bench.py BENCH_MESH=1, docs/DISTRIBUTED.md): trees/s per sharding,
-    the planner's chosen mesh, the in-pair ratio, and the compiled-HLO
-    collective census of the GSPMD executable.  A host-mesh rung: it
-    compares the collective FORMULATIONS, so the ratio is informational
-    — the parallel_impl default on TPU awaits an on-chip pair."""
+    """Per-shape lines for the mesh rung A/Bs (bench.py BENCH_MESH=1,
+    docs/DISTRIBUTED.md): trees/s per sharding with the telemetry
+    kernel identity, the planner's chosen mesh, the in-pair ratios, any
+    loud layout downgrades, and the compiled-HLO collective census of
+    the GSPMD executable.  Covers both the shard_map-vs-GSPMD rung
+    (bench_mesh.json) and the gspmd_hist fused-vs-flat rung
+    (BENCH_MESH_FUSED=1, bench_mesh_fused.json).  A host-mesh rung: it
+    compares the collective FORMULATIONS, so the ratios are
+    informational — on-TPU defaults await an on-chip pair."""
     m = d.get("mesh")
     if not isinstance(m, dict):
         return []
     out = []
     for shape, cfgs in (m.get("shapes") or {}).items():
-        parts = []
-        for name in ("gspmd_data", "gspmd_feature", "gspmd_auto",
-                     "shardmap_data"):
-            rec = cfgs.get(name)
+        parts, ratios, downs = [], [], []
+        for name, rec in cfgs.items():
+            if isinstance(rec, (int, float)):
+                ratios.append(f"{name}={rec}")
+                continue
             if not isinstance(rec, dict):
                 continue
             if "error" in rec:
                 parts.append(f"{name}=ERR")
                 continue
             mesh_tag = f"@{rec['mesh']}" if rec.get("mesh") else ""
-            parts.append(f"{name}{mesh_tag}={rec.get('trees_per_sec')}")
-        ratio = cfgs.get("gspmd_vs_shardmap")
-        if ratio is not None:
-            parts.append(f"gspmd/shardmap={ratio}")
-        out.append(f"mesh[{shape}]: " + ", ".join(parts))
-        gd = cfgs.get("gspmd_data") or {}
+            kern = rec.get("observed_kernel")
+            kern_tag = f"[{kern}]" if kern else ""
+            parts.append(f"{name}{mesh_tag}{kern_tag}="
+                         f"{rec.get('trees_per_sec')}")
+            for ev in rec.get("downgrades") or []:
+                downs.append(f"  {name} DOWNGRADE "
+                             f"{ev.get('requested')}->{ev.get('resolved')}"
+                             f": {ev.get('reason')}")
+        out.append(f"mesh[{shape}]: " + ", ".join(parts + ratios))
+        out.extend(downs)
+        gd = (cfgs.get("gspmd_data") or cfgs.get("gspmd_fused_data")
+              or cfgs.get("gspmd_fused_2x4") or {})
         cen = gd.get("collectives")
         if isinstance(cen, dict) and cen:
             ops = ", ".join(f"{op} {rec['count']}x/{rec['bytes']}B"
                             for op, rec in sorted(cen.items()))
             out.append(f"  gspmd collectives (compiled HLO): {ops}")
+    if m.get("fused_ab"):
+        out.append("  gspmd_hist flip: fused_vs_flat_* >= 1.05 with "
+                   "observed_kernel agreeing per side -> gspmd_hist "
+                   "auto->fused (boosting._setup_gspmd); host-mesh "
+                   "numbers are informational, the on-chip pair decides")
     return out
+
+
+def probe_failed_row(d):
+    """Render a structured probe_failed artifact (a stage that timed out
+    or died mid-tunnel; tpu_capture_phase2.sh fail_artifact / the
+    microprobe's SIGTERM flush) — distinct from "not captured"."""
+    if not isinstance(d, dict) or d.get("kind") != "probe_failed":
+        return None
+    sig = f" [{d['signal']}]" if d.get("signal") else ""
+    return (f"PROBE FAILED rc={d.get('rc')}{sig} at stage "
+            f"'{d.get('stage')}' — see stderr_tail in the artifact")
 
 
 def main():
@@ -232,6 +257,11 @@ def main():
     head = load(os.path.join(cap, "bench_1m.json"))
     if not head:
         print("no headline bench in", cap)
+        return
+    hpf = probe_failed_row(head)
+    if hpf:
+        print(f"headline: {hpf}")
+        print("headline stage died -> NO flip decisions from this capture")
         return
     deciding = clean_tpu(head)
     obs = observed_kernel(head)
@@ -257,6 +287,8 @@ def main():
         d = load(os.path.join(cap, fname))
         if d is None:
             print(f"{fname:34} {'—':>9} {'—':>8}  (not captured)")
+        elif probe_failed_row(d):
+            print(f"{fname:34} {'—':>9} {'—':>8}  {probe_failed_row(d)}")
         else:
             print(f"{fname:34} {d['value']:>9} {'—':>8}  coverage shape, "
                   f"platform {platform(d)}, "
@@ -287,6 +319,10 @@ def main():
         d = load(os.path.join(cap, fname))
         if d is None:
             print(f"{fname:34} {'—':>9} {'—':>8}  (not captured)")
+            continue
+        if probe_failed_row(d):
+            print(f"{fname:34} {'—':>9} {'—':>8}  {probe_failed_row(d)}: "
+                  f"no decision ({knob})")
             continue
         base = head if base_name is None else load(
             os.path.join(cap, base_name))
@@ -329,6 +365,12 @@ def main():
     mp = load(os.path.join(cap, "microprobe.json"))
     if mp:
         print()
+        mpf = probe_failed_row(mp) or probe_failed_row(
+            mp.get("probe_failed"))
+        if mpf:
+            # the SIGTERM flush banks partial numbers under the failure
+            # marker; render the failure AND whatever was measured
+            print(f"microprobe: {mpf}")
         print("microprobe decomposition:",
               {k: round(mp[k], 3) for k in
                ("grow_per_split_fixed_ms", "grow_per_mrow_ms", "grow_ms",

@@ -18,7 +18,12 @@ Both artifacts must be the same kind; the kind is sniffed from content:
 * **metrics snapshot** — a ``.prom``/``.txt`` Prometheus scrape or the
   ``{"schema_version", "samples"}`` block ``obs/metrics.snapshot()``
   emits (bench JSONs embed one as ``metrics_snapshot``) — drift on
-  latency/memory samples, dispatch-identity label-set mismatch.
+  latency/memory samples, dispatch-identity label-set mismatch;
+* **probe_failed record** (``{"kind": "probe_failed", ...}``, written by
+  ``tpu_capture_phase2.sh fail_artifact`` or the microprobe's SIGTERM
+  flush when a stage dies) — sniffed on EITHER side: a failed candidate
+  is a FAIL finding naming the dead stage and exit code, a failed
+  baseline is a warn (nothing to compare against), never a load error.
 
 Exit codes: 0 = within thresholds, 1 = regression (any FAIL finding),
 2 = usage/load error.  ``--json`` prints the findings structurally.
@@ -88,6 +93,11 @@ def load_artifact(path):
         doc = json.loads(text)
     if isinstance(doc, list):
         return "trace", doc
+    if doc.get("kind") == "probe_failed" or (
+            isinstance(doc.get("probe_failed"), dict)):
+        # a stage that died left a structured failure record (or the
+        # microprobe's partial dict carrying one) in the artifact's place
+        return "probe_failed", doc
     if "traceEvents" in doc:
         return "trace", list(doc["traceEvents"])
     if "samples" in doc:
@@ -237,11 +247,39 @@ def compare_metrics(a, b, thresholds):
 # --------------------------------------------------------------------- CLI
 
 
+def _probe_failure(d):
+    """The probe_failed record inside an artifact (top-level or the
+    microprobe's partial-flush subkey)."""
+    if d.get("kind") == "probe_failed":
+        return d
+    return d.get("probe_failed")
+
+
 def compare(path_a, path_b, thresholds):
     """(kind, findings) for two artifact paths; raises ValueError on a
     kind mismatch."""
     kind_a, a = load_artifact(path_a)
     kind_b, b = load_artifact(path_b)
+    if "probe_failed" in (kind_a, kind_b):
+        # never a load error: render the dead stage as a finding so the
+        # capture verdict names it (FAIL only when the CANDIDATE died —
+        # a failed baseline leaves nothing to regress against)
+        f = []
+        if kind_b == "probe_failed":
+            pf = _probe_failure(b) or {}
+            sig = f" [{pf['signal']}]" if pf.get("signal") else ""
+            f.append(_finding(
+                "probe_failed", FAIL,
+                f"candidate stage '{pf.get('stage')}' died "
+                f"rc={pf.get('rc')}{sig}"))
+        if kind_a == "probe_failed":
+            pf = _probe_failure(a) or {}
+            f.append(_finding(
+                "probe_failed", WARN,
+                f"baseline is a probe_failed record (stage "
+                f"'{pf.get('stage')}', rc={pf.get('rc')}) — nothing to "
+                f"compare against"))
+        return "probe_failed", f
     if kind_a != kind_b:
         raise ValueError(f"artifact kinds differ: {path_a} is {kind_a}, "
                          f"{path_b} is {kind_b}")

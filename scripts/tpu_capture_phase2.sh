@@ -40,6 +40,33 @@ memsnap() {
         > "$OUT/memstats_$1.json" 2>> "$OUT/log.txt" || true
 }
 
+fail_artifact() {
+    # $1 stage name, $2 exit code, $3 the JSON artifact the dead stage
+    # failed to produce.  A stage that times out or dies mid-tunnel used
+    # to leave an EMPTY file — downstream tooling (decide_flips,
+    # obs_diff) saw a hole it could not tell apart from "never ran".
+    # This writes a structured probe_failed record in its place: stage,
+    # exit code (124 = SIGTERM timeout, 137 = SIGKILL after the -k
+    # grace), and the stderr tail with the actual failure.
+    local stage=$1 rc=$2 dest=$3
+    echo "stage '$stage' FAILED rc=$rc - writing probe_failed artifact" \
+        | tee -a "$OUT/log.txt"
+    python - "$stage" "$rc" "$dest" "$OUT/log.txt" <<'PY' || true
+import json, pathlib, sys
+stage, rc, dest, log = sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4]
+tail = ""
+try:
+    tail = pathlib.Path(log).read_text(errors="replace")[-2000:]
+except OSError:
+    pass
+sig = {124: "SIGTERM (timeout)", 125: "timeout-cmd failure",
+       137: "SIGKILL (timeout -k grace expired / oom)"}.get(rc)
+json.dump({"kind": "probe_failed", "stage": stage, "rc": rc,
+           "signal": sig, "stderr_tail": tail},
+          open(dest, "w"), indent=1)
+PY
+}
+
 echo "== probe ==" | tee "$OUT/log.txt"
 if ! timeout 120 python -c "import jax; print(jax.devices())" \
         >> "$OUT/log.txt" 2>&1; then
@@ -68,8 +95,9 @@ alive_or_abort() {
 
 echo "== headline bench 1M (current defaults) ==" | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m.jsonl" \
-BENCH_TREES=10 BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m.json" 2>> "$OUT/log.txt"
+BENCH_TREES=10 BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
+    > "$OUT/bench_1m.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "headline" $? "$OUT/bench_1m.json"
 cat "$OUT/bench_1m.json" | tee -a "$OUT/log.txt"
 # per-phase/per-kernel telemetry report for the headline rung (the trace
 # file is written by the measured child; decide_flips reads the observed
@@ -104,25 +132,30 @@ snap "headline bench"
 alive_or_abort "headline"
 echo "== microprobe (latency vs device time; names the residual) ==" \
     | tee -a "$OUT/log.txt"
-timeout 1500 python scripts/tpu_microprobe.py 1000000 \
-    > "$OUT/microprobe.json" 2>> "$OUT/log.txt"
+# -k 30: the probe traps SIGTERM and flushes the partial result dict, so
+# a timeout banks everything measured so far instead of an empty file
+timeout -k 30 1500 python scripts/tpu_microprobe.py 1000000 \
+    > "$OUT/microprobe.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "microprobe" $? "$OUT/microprobe.json"
 cat "$OUT/microprobe.json" | tee -a "$OUT/log.txt"
 snap "microprobe"
 
 alive_or_abort "microprobe"
-echo "== gen-1 forced A/B (fused rung dropped; headline pairs with this) ==" \
+echo "== forced-XLA A/B (fused rung dropped; headline pairs with this) ==" \
     | tee -a "$OUT/log.txt"
-# the default ladder tries tpu+fused first, so bench_1m.json IS the gen-2
-# number when the kernel lowers; this stage forces the gen-1 rung for the
-# direct A/B pair (decide_flips: pallas_fused auto->on if fused wins >=5%)
-BENCH_TRACE="$OUT/trace_1m_gen1.jsonl" \
-BENCH_TREES=6 BENCH_FUSED=0 BENCH_STAGE_TIMEOUT=1200 timeout 1500 \
-    python bench.py > "$OUT/bench_1m_gen1.json" 2>> "$OUT/log.txt"
-cat "$OUT/bench_1m_gen1.json" | tee -a "$OUT/log.txt"
-memsnap "1m_gen1"
-snap "gen-1 forced A/B"
+# the default ladder tries tpu+fused first, so bench_1m.json IS the fused
+# number when the kernel lowers; this stage forces the einsum reference
+# rung for the direct A/B pair (decide_flips: pallas_fused auto->on if
+# fused wins >=5%)
+BENCH_TRACE="$OUT/trace_1m_xla.jsonl" \
+BENCH_TREES=6 BENCH_FUSED=0 BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 \
+    python bench.py > "$OUT/bench_1m_xla.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "xla_ab" $? "$OUT/bench_1m_xla.json"
+cat "$OUT/bench_1m_xla.json" | tee -a "$OUT/log.txt"
+memsnap "1m_xla"
+snap "forced-XLA A/B"
 
-alive_or_abort "gen-1 A/B"
+alive_or_abort "xla A/B"
 echo "== leaves sweep (deep-tree per-split fixed cost, 31 vs 255) ==" \
     | tee -a "$OUT/log.txt"
 # marginal ms/leaf at fixed N on-chip — the round-7 CPU collapse
@@ -130,7 +163,8 @@ echo "== leaves sweep (deep-tree per-split fixed cost, 31 vs 255) ==" \
 # this rung measures the same curve the bench JSON tracks per round
 BENCH_TRACE="$OUT/trace_leaves.jsonl" \
 BENCH_LEAVES_SWEEP=1 BENCH_TREES=4 BENCH_STAGE_TIMEOUT=1500 timeout 1800 \
-    python bench.py > "$OUT/bench_leaves.json" 2>> "$OUT/log.txt"
+    python bench.py > "$OUT/bench_leaves.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "leaves" $? "$OUT/bench_leaves.json"
 cat "$OUT/bench_leaves.json" | tee -a "$OUT/log.txt"
 memsnap "leaves"
 snap "leaves sweep"
@@ -147,14 +181,16 @@ echo "== fused split-find A/B (leaves sweep, fused vs forced-chain) ==" \
 BENCH_TRACE="$OUT/trace_leaves_fused.jsonl" \
 BENCH_LEAVES_SWEEP=1 BENCH_LEAVES_AB=0 BENCH_TREES=4 \
     BENCH_EXTRA_PARAMS=split_find=fused \
-    BENCH_STAGE_TIMEOUT=1500 timeout 1800 python bench.py \
-    > "$OUT/bench_leaves_fused.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=1500 timeout -k 30 1800 python bench.py \
+    > "$OUT/bench_leaves_fused.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "leaves_fused" $? "$OUT/bench_leaves_fused.json"
 cat "$OUT/bench_leaves_fused.json" | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_leaves_chain.jsonl" \
 BENCH_LEAVES_SWEEP=1 BENCH_LEAVES_AB=0 BENCH_TREES=4 \
     BENCH_EXTRA_PARAMS=split_find=chain \
-    BENCH_STAGE_TIMEOUT=1500 timeout 1800 python bench.py \
-    > "$OUT/bench_leaves_chain.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=1500 timeout -k 30 1800 python bench.py \
+    > "$OUT/bench_leaves_chain.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "leaves_chain" $? "$OUT/bench_leaves_chain.json"
 cat "$OUT/bench_leaves_chain.json" | tee -a "$OUT/log.txt"
 snap "split-find A/B"
 
@@ -169,7 +205,8 @@ echo "== serving rung (SoA microbatch engine: latency/QPS + recompile pin) ==" \
 # training for the first time
 BENCH_TRACE="$OUT/trace_serving.jsonl" \
 BENCH_SERVING=1 BENCH_TREES=6 BENCH_STAGE_TIMEOUT=1500 timeout 1800 \
-    python bench.py > "$OUT/bench_serving.json" 2>> "$OUT/log.txt"
+    python bench.py > "$OUT/bench_serving.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "serving" $? "$OUT/bench_serving.json"
 cat "$OUT/bench_serving.json" | tee -a "$OUT/log.txt"
 timeout 300 python -m lightgbm_tpu.obs "$OUT/trace_serving.jsonl" \
     > "$OUT/trace_serving.md" 2>> "$OUT/log.txt" || true
@@ -183,18 +220,34 @@ echo "== mesh rung (GSPMD vs shard_map on the forced 8-device host mesh) ==" \
 # collective FORMULATIONS — who inserts them, what payloads move (the
 # compiled-HLO census rides the JSON) — cheap even mid-tunnel since it
 # never touches the TPU; the on-chip default still awaits a real slice
-BENCH_MESH=1 BENCH_STAGE_TIMEOUT=1800 timeout 2100 python bench.py \
-    > "$OUT/bench_mesh.json" 2>> "$OUT/log.txt"
+BENCH_MESH=1 BENCH_STAGE_TIMEOUT=1800 timeout -k 30 2100 python bench.py \
+    > "$OUT/bench_mesh.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "mesh" $? "$OUT/bench_mesh.json"
 cat "$OUT/bench_mesh.json" | tee -a "$OUT/log.txt"
 snap "mesh rung"
 
 alive_or_abort "mesh rung"
+echo "== mesh fused A/B (gspmd_hist fused-vs-flat on the host mesh) ==" \
+    | tee -a "$OUT/log.txt"
+# the shard_map-island hybrid against the flat scatter-add, data mesh +
+# 2x4 hybrid mesh + feature-wide shape, with per-config kernel-identity
+# telemetry and the collective census (decide_flips: gspmd_hist
+# auto->fused); host-mesh by construction like bench_mesh.json
+BENCH_MESH=1 BENCH_MESH_FUSED=1 BENCH_STAGE_TIMEOUT=1800 \
+    timeout -k 30 2100 python bench.py \
+    > "$OUT/bench_mesh_fused.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "mesh_fused" $? "$OUT/bench_mesh_fused.json"
+cat "$OUT/bench_mesh_fused.json" | tee -a "$OUT/log.txt"
+snap "mesh fused A/B"
+
+alive_or_abort "mesh fused A/B"
 echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_ordered_sort.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on,partition_impl=sort \
-    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m_ordered_sort.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
+    > "$OUT/bench_1m_ordered_sort.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "1m_ordered_sort" $? "$OUT/bench_1m_ordered_sort.json"
 cat "$OUT/bench_1m_ordered_sort.json" | tee -a "$OUT/log.txt"
 snap "ordered+sort A/B"
 
@@ -205,13 +258,15 @@ if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
         -q >> "$OUT/log.txt" 2>&1; then
     BENCH_TRACE="$OUT/trace_1m_compact.jsonl" \
     BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact \
-        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-        > "$OUT/bench_1m_compact.json" 2>> "$OUT/log.txt"
+        BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
+        > "$OUT/bench_1m_compact.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "1m_compact" $? "$OUT/bench_1m_compact.json"
     cat "$OUT/bench_1m_compact.json" | tee -a "$OUT/log.txt"
     BENCH_TRACE="$OUT/trace_1m_compact_ordered.jsonl" \
     BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=compact,ordered_bins=on \
-        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-        > "$OUT/bench_1m_compact_ordered.json" 2>> "$OUT/log.txt"
+        BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
+        > "$OUT/bench_1m_compact_ordered.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "1m_compact_ordered" $? "$OUT/bench_1m_compact_ordered.json"
     cat "$OUT/bench_1m_compact_ordered.json" | tee -a "$OUT/log.txt"
     snap "compact-partition A/B"
 else
@@ -221,31 +276,13 @@ else
 fi
 
 alive_or_abort "compact"
-echo "== nibble kernel Mosaic gate + A/B bench ==" | tee -a "$OUT/log.txt"
-# only worth a bench slot if the Mosaic gate passes (a failed gate means
-# the same compile error would burn this stage's whole timeout)
-if LGBM_TPU_TESTS_ON_TPU=1 timeout 600 python -m pytest \
-        "tests/test_tpu.py::test_pallas_nibble_compiles_on_tpu" \
-        -q >> "$OUT/log.txt" 2>&1; then
-    BENCH_TRACE="$OUT/trace_1m_nibble.jsonl" \
-    BENCH_TREES=6 BENCH_EXTRA_PARAMS=pallas_hist_impl=nibble \
-        BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-        > "$OUT/bench_1m_nibble.json" 2>> "$OUT/log.txt"
-    cat "$OUT/bench_1m_nibble.json" | tee -a "$OUT/log.txt"
-    snap "nibble A/B"
-else
-    echo "nibble Mosaic gate FAILED - skipping nibble bench" \
-        | tee -a "$OUT/log.txt"
-    snap "nibble gate failed"
-fi
-
-alive_or_abort "nibble"
 echo "== bench 63-bin (the reference's own GPU benchmark setting) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_63bin.jsonl" \
 BENCH_TREES=10 BENCH_MAX_BIN=63 BENCH_STAGE_TIMEOUT=1200 \
-    timeout 1500 python bench.py \
-    > "$OUT/bench_1m_63bin.json" 2>> "$OUT/log.txt"
+    timeout -k 30 1500 python bench.py \
+    > "$OUT/bench_1m_63bin.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "1m_63bin" $? "$OUT/bench_1m_63bin.json"
 cat "$OUT/bench_1m_63bin.json" | tee -a "$OUT/log.txt"
 snap "63-bin bench"
 
@@ -253,8 +290,9 @@ alive_or_abort "63-bin"
 echo "== FULL Higgs 10.5M x 28 (north-star shape) ==" | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_higgs_full.jsonl" \
 BENCH_ROWS=10500000 BENCH_TREES=3 BENCH_STAGE_TIMEOUT=2400 \
-    timeout 2700 python bench.py \
-    > "$OUT/bench_higgs_full.json" 2>> "$OUT/log.txt"
+    timeout -k 30 2700 python bench.py \
+    > "$OUT/bench_higgs_full.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "higgs_full" $? "$OUT/bench_higgs_full.json"
 cat "$OUT/bench_higgs_full.json" | tee -a "$OUT/log.txt"
 memsnap "higgs_full"
 snap "full Higgs 10.5M"
@@ -263,8 +301,9 @@ alive_or_abort "full Higgs"
 echo "== ordered_bins A/B (attribution) ==" | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_ordered.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=ordered_bins=on \
-    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m_ordered.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
+    > "$OUT/bench_1m_ordered.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "1m_ordered" $? "$OUT/bench_1m_ordered.json"
 cat "$OUT/bench_1m_ordered.json" | tee -a "$OUT/log.txt"
 snap "ordered_bins A/B"
 
@@ -272,8 +311,9 @@ alive_or_abort "ordered A/B"
 echo "== partition_impl=sort A/B (attribution) ==" | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_sortpart.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=partition_impl=sort \
-    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m_sortpart.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
+    > "$OUT/bench_1m_sortpart.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "1m_sortpart" $? "$OUT/bench_1m_sortpart.json"
 cat "$OUT/bench_1m_sortpart.json" | tee -a "$OUT/log.txt"
 snap "sort-partition A/B"
 
@@ -281,8 +321,9 @@ alive_or_abort "sort A/B"
 echo "== gather_words A/B (words off) ==" | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_nowords.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_words=off \
-    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m_nowords.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
+    > "$OUT/bench_1m_nowords.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "1m_nowords" $? "$OUT/bench_1m_nowords.json"
 cat "$OUT/bench_1m_nowords.json" | tee -a "$OUT/log.txt"
 snap "gather_words A/B"
 
@@ -291,8 +332,9 @@ echo "== gather_panel A/B (weights folded into the word gather) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_nopanel.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=gather_panel=off \
-    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m_nopanel.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
+    > "$OUT/bench_1m_nopanel.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "1m_nopanel" $? "$OUT/bench_1m_nopanel.json"
 cat "$OUT/bench_1m_nopanel.json" | tee -a "$OUT/log.txt"
 snap "gather_panel A/B"
 
@@ -301,8 +343,9 @@ echo "== bucket_scheme=pow15 A/B (1.5x buckets, less padding) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_pow15.jsonl" \
 BENCH_TREES=6 BENCH_EXTRA_PARAMS=bucket_scheme=pow15 \
-    BENCH_STAGE_TIMEOUT=1200 timeout 1500 python bench.py \
-    > "$OUT/bench_1m_pow15.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
+    > "$OUT/bench_1m_pow15.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "1m_pow15" $? "$OUT/bench_1m_pow15.json"
 cat "$OUT/bench_1m_pow15.json" | tee -a "$OUT/log.txt"
 snap "pow15 A/B"
 
@@ -317,8 +360,9 @@ alive_or_abort "on-chip tier"
 echo "== bench wide (Epsilon-shaped) ==" | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_wide.jsonl" \
 BENCH_ROWS=200000 BENCH_ROWS_CPU=200000 BENCH_FEATURES=2000 \
-    BENCH_TREES=5 BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
-    > "$OUT/bench_wide.json" 2>> "$OUT/log.txt"
+    BENCH_TREES=5 BENCH_STAGE_TIMEOUT=2400 timeout -k 30 2700 python bench.py \
+    > "$OUT/bench_wide.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "wide" $? "$OUT/bench_wide.json"
 cat "$OUT/bench_wide.json" | tee -a "$OUT/log.txt"
 memsnap "wide"
 snap "wide bench"
@@ -328,23 +372,25 @@ echo "== bench sparse (EFB + nibble packing) ==" | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_sparse.jsonl" \
 BENCH_ROWS=1000000 BENCH_ROWS_CPU=1000000 BENCH_SPARSITY=0.9 \
     BENCH_FEATURES=100 BENCH_TREES=5 \
-    BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
-    > "$OUT/bench_sparse.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=2400 timeout -k 30 2700 python bench.py \
+    > "$OUT/bench_sparse.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "sparse" $? "$OUT/bench_sparse.json"
 cat "$OUT/bench_sparse.json" | tee -a "$OUT/log.txt"
 
 BENCH_TRACE="$OUT/trace_sparse_nopack.jsonl" \
 BENCH_ROWS=1000000 BENCH_ROWS_CPU=1000000 BENCH_SPARSITY=0.9 \
     BENCH_FEATURES=100 BENCH_TREES=5 \
     BENCH_EXTRA_PARAMS=enable_bin_packing=false \
-    BENCH_STAGE_TIMEOUT=2400 timeout 2700 python bench.py \
-    > "$OUT/bench_sparse_nopack.json" 2>> "$OUT/log.txt"
+    BENCH_STAGE_TIMEOUT=2400 timeout -k 30 2700 python bench.py \
+    > "$OUT/bench_sparse_nopack.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "sparse_nopack" $? "$OUT/bench_sparse_nopack.json"
 cat "$OUT/bench_sparse_nopack.json" | tee -a "$OUT/log.txt"
 memsnap "sparse"
 snap "sparse bench + packing A/B"
 
 alive_or_abort "sparse bench"
 echo "== profile sweep ==" | tee -a "$OUT/log.txt"
-timeout 1800 python scripts/tpu_profile.py 1000000 \
+timeout -k 30 1800 python scripts/tpu_profile.py 1000000 \
     >> "$OUT/log.txt" 2>&1
 tail -40 "$OUT/log.txt"
 snap "profile sweep"

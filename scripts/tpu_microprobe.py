@@ -9,7 +9,7 @@ the GPU learner's per-phase timing, gpu_tree_learner.cpp + TIMETAG):
   1. round-trip latency of a trivial jitted op (dispatch + block);
   2. pipelined dispatch rate (N dispatches, one block) - the cost floor of
      an async training loop;
-  3. subset_histogram (Pallas) at several row counts, amortized: the hot op;
+  3. subset_histogram (XLA reference rung) at several row counts, amortized;
   4. the gather / cumsum / scatter trio the partition is built from, at the
      root-split window size;
   5. grow_tree end-to-end, amortized over 5 calls with ONE final block;
@@ -18,10 +18,16 @@ the GPU learner's per-phase timing, gpu_tree_learner.cpp + TIMETAG):
 Writes one JSON dict to stdout (plus progress on stderr); tpu_capture.sh
 saves it as evidence.  Runs on whatever backend jax picks - on CPU it is a
 rehearsal, numbers are only meaningful on the chip.
+
+On SIGTERM (the capture playbook's ``timeout -k 30``) the probe flushes
+the PARTIAL result dict before dying: a stage timeout banks every number
+measured so far — with ``"probe_failed"`` naming the interrupted step —
+instead of leaving an empty artifact.
 """
 import functools
 import json
 import os
+import signal
 import sys
 import time
 
@@ -59,8 +65,22 @@ def main():
     import jax
     import jax.numpy as jnp
     res = {"platform": jax.devices()[0].platform, "rows": rows}
+    stage = {"name": "startup"}
+
+    def _flush_partial(signum, frame):
+        # SIGTERM from the playbook's `timeout -k`: bank the partial dict
+        # (stdout is the artifact) and exit before SIGKILL lands
+        res["probe_failed"] = {
+            "kind": "probe_failed", "stage": stage["name"],
+            "signal": signal.Signals(signum).name,
+            "rc": 128 + signum}
+        print(json.dumps(res), flush=True)
+        os._exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _flush_partial)
     print(f"platform: {res['platform']}", file=sys.stderr, flush=True)
 
+    stage["name"] = "rtt"
     # 1. round-trip latency ---------------------------------------------------
     one = jnp.ones((8,), jnp.float32)
     add = jax.jit(lambda x: x + 1)
@@ -70,6 +90,7 @@ def main():
     print(f"rtt {res['rtt_ms']:.1f} ms, tiny device_get "
           f"{res['device_get_tiny_ms']:.1f} ms", file=sys.stderr, flush=True)
 
+    stage["name"] = "dispatch"
     # 2. pipelined dispatch rate ---------------------------------------------
     def burst():
         x = one
@@ -80,11 +101,12 @@ def main():
     print(f"pipelined dispatch {res['dispatch_pipelined_ms']:.2f} ms/op",
           file=sys.stderr, flush=True)
 
+    stage["name"] = "hist"
     # 3. histogram op at several sizes ---------------------------------------
     from lightgbm_tpu.ops.histogram import subset_histogram
     rng = np.random.RandomState(0)
     f = 28
-    method = "pallas" if res["platform"] == "tpu" else "segment"
+    method = "einsum" if res["platform"] == "tpu" else "segment"
     res["hist_method"] = method
     bins_full = jnp.asarray(rng.randint(0, 255, size=(rows, f), dtype=np.uint8))
     res["hist_ms"] = {}
@@ -101,6 +123,7 @@ def main():
         print(f"hist {m} rows: {res['hist_ms'][str(m)]:.1f} ms",
               file=sys.stderr, flush=True)
 
+    stage["name"] = "partition"
     # 4. partition primitives at the root window size ------------------------
     n = rows
     order = jnp.asarray(np.arange(n, dtype=np.int32))
@@ -148,13 +171,14 @@ def main():
           f"{res['gather_words_plus3_ms']:.1f} / panel "
           f"{res['gather_panel_ms']:.1f} ms", file=sys.stderr, flush=True)
 
-    # 4b3. gen-2 fused-gather kernel head-to-head with the gen-1 pipeline
-    # it replaces: compare hist_fused_ms[m] against gather_rows_words_ms
-    # (scaled by m/rows) + hist_ms[m] — the fused kernel folds both into
-    # one dispatch with no [M, F] staging buffer.  TPU only: interpret-
-    # mode timings mean nothing, and a Mosaic rejection here is itself
-    # evidence (recorded, like the compact probe).
+    # 4b3. fused-gather kernel head-to-head with the external-gather +
+    # XLA-histogram pipeline it replaces: compare hist_fused_ms[m] against
+    # gather_rows_words_ms (scaled by m/rows) + hist_ms[m] — the fused
+    # kernel folds both into one dispatch with no staging buffer.  TPU
+    # only: interpret-mode timings mean nothing, and a Mosaic rejection
+    # here is itself evidence (recorded, like the compact probe).
     if res["platform"] == "tpu":
+        stage["name"] = "hist_fused"
         try:
             from lightgbm_tpu.data.packing import pack_fused_panel
             from lightgbm_tpu.ops.histogram import subset_histogram_fused
@@ -273,6 +297,7 @@ def main():
           f"{res['partition_window_ms']:.1f} ms (opt "
           f"{res['partition_window_opt_ms']:.1f})", file=sys.stderr, flush=True)
 
+    stage["name"] = "grower"
     # 5 + 6. the real grower and booster -------------------------------------
     from bench import make_data
     from lightgbm_tpu.config import config_from_params
@@ -320,6 +345,7 @@ def main():
     # tunnel) and the tunnel has died inside it once already
     sys.stdout.flush()
 
+    stage["name"] = "rows_sweep"
     # 5b. rows-sweep decomposition: grow wall ~ a + b*rows at fixed 255
     # leaves, so the intercept a / 254 splits is the per-split FIXED cost
     # (kernel-launch / small-op overhead in the while-loop body) and b the

@@ -81,7 +81,7 @@ def main():
 
     # --- baseline config + phase breakdown -----------------------------------
     tps, comp, bst = train_tps(X, y)
-    print(f"\nbaseline (ft=8, rt=512, bmin=10): {tps:.3f} trees/s "
+    print(f"\nbaseline (rt=512, bmin=10): {tps:.3f} trees/s "
           f"(compile {comp:.0f}s)")
     print("phases:", bst.timers.report(), flush=True)
 
@@ -96,17 +96,17 @@ def main():
           f"MFU at measured rate: {flops_tree * tps / peak * 100:.2f}%")
 
     # --- tile sweep ----------------------------------------------------------
+    # the fused kernel's only tiling knob is the row tile (feature tiling
+    # died with the retired gen-1 kernels)
     print("\ntile sweep (trees/s):")
-    for ft, rt in [(4, 512), (8, 256), (8, 512), (8, 1024), (16, 512),
-                   (16, 1024), (32, 512)]:
+    for rt in (256, 512, 1024, 2048):
         try:
             tps_i, comp_i, _ = train_tps(X, y, n_timed=5,
-                                         pallas_feat_tile=ft,
                                          pallas_row_tile=rt)
-            print(f"  feat_tile={ft:3d} row_tile={rt:5d}: {tps_i:7.3f} "
+            print(f"  row_tile={rt:5d}: {tps_i:7.3f} "
                   f"(compile {comp_i:.0f}s)", flush=True)
         except Exception as e:
-            print(f"  feat_tile={ft:3d} row_tile={rt:5d}: FAILED "
+            print(f"  row_tile={rt:5d}: FAILED "
                   f"{str(e)[:120]}", flush=True)
 
     # --- gather bucket sweep -------------------------------------------------

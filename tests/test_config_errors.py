@@ -20,15 +20,8 @@ from lightgbm_tpu.config import config_from_params
     ({"boosting": "rf"}, "bagging"),
     ({"max_bin": 100000}, "max_bin"),
     ({"pallas_row_tile": 100}, "multiple of 128"),
-    ({"pallas_feat_tile": -1}, "positive"),
     ({"gather_words": "maybe"}, "gather_words"),
-    ({"pallas_hist_impl": "fancy"}, "pallas_hist_impl"),
-    # with bin packing OFF the effective width is raw max_bin; with it ON
-    # the joint-packed axis is 256 wide and nibble is shape-valid at any
-    # max_bin (advisor r4) — only the former is rejected
-    ({"pallas_hist_impl": "nibble", "max_bin": 63,
-      "enable_bin_packing": False}, "width > 128"),
-    ({"pallas_hist_impl": "nibble", "pallas_feat_tile": 4}, "divisible"),
+    ({"gspmd_hist": "scatter"}, "gspmd_hist"),
     ({"metric": "made_up_metric", "objective": "binary"}, "metric"),
 ])
 def test_bad_params_rejected(params, msg):

@@ -240,13 +240,13 @@ def test_grower_255_leaf_tree_identical_across_rungs():
 
 def test_fused_warns_and_falls_back_on_wide_bins():
     """A > 2-byte bin matrix cannot word-pack: the grower must degrade
-    loudly to the gen-1 kernel, not crash or mislabel."""
+    loudly to the XLA reference rung, not crash or mislabel."""
     n, f, b = 1500, 6, 63
     bins, g, h, c = _problem(n, f, b, seed=29, dtype=np.int32)
     c[:] = 1.0
     t_seg, _ = _grow_tree_strings("segment", bins, g, h, c, b)
-    # fused request on an unfusable layout: falls back to pallas;
-    # hist_interpret keeps the gen-1 kernel off Mosaic on this CPU host
+    # fused request on an unfusable layout: falls back to the XLA
+    # reference (segment on this CPU host, einsum on TPU)
     t_fus, _ = _grow_tree_strings("fused", bins, g, h, c, b)
     np.testing.assert_array_equal(t_seg.split_feature, t_fus.split_feature)
     np.testing.assert_array_equal(t_seg.threshold_bin, t_fus.threshold_bin)

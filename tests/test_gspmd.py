@@ -137,6 +137,174 @@ def test_gspmd_missing_direction_identical():
     np.testing.assert_array_equal(np.asarray(rl_s), np.asarray(rl_g))
 
 
+# ---- the gspmd_hist=fused hybrid (shard_map islands) -----------------------
+
+
+def _fused_cfg(**kw):
+    """The hybrid grower's config: the fused Pallas kernel inside the
+    GSPMD program's shard_map islands, interpret mode on this CPU host
+    (same program shape as the chip, kernel emulated)."""
+    return _cfg(hist_method="fused", hist_interpret=True, **kw)
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (1, 8), (2, 4)],
+                         ids=["8x1", "1x8", "2x4"])
+def test_gspmd_fused_hybrid_byte_identical_across_mesh_shapes(
+        shape, serial_result):
+    """Tentpole acceptance: the hybrid — each device running the fused
+    gather-histogram kernel over its row shard inside a shard_map island,
+    the partitioner owning the cross-shard reduction — grows the SAME
+    tree as the single-device grower on every mesh shape, to the byte
+    (integer-valued weights make every f32 histogram sum
+    order-insensitive, so bf16 hi/lo splitting of exact small integers
+    is also exact)."""
+    tree_s, rl_s = serial_result
+    tree_g, rl_g = _gspmd_grow(make_named_mesh(*shape), cfg=_fused_cfg())
+    for name, a, b in zip(tree_s._fields, tree_s, tree_g):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"TreeArrays.{name} diverged on the {shape} hybrid")
+    np.testing.assert_array_equal(rl_s, rl_g)
+
+
+def test_gspmd_fused_hybrid_missing_direction_identical():
+    """has_missing routing (default-direction decisions) composed with
+    the hybrid islands: identical trees."""
+    cfg_s = _cfg(has_missing=True)
+    bins, g, h, c = _int_args(seed=3)
+    meta = _meta(missing=True)
+    tree_s, rl_s = jax.jit(make_grower(cfg_s))(
+        jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+        meta, jnp.ones((F,), bool))
+    mesh = make_named_mesh(2, 4)
+    grow = make_gspmd_grower(_fused_cfg(has_missing=True), mesh)
+    rs = NamedSharding(mesh, P(BATCH_AXIS))
+    tree_g, rl_g = grow(
+        jax.device_put(bins, NamedSharding(mesh, P(BATCH_AXIS, None))),
+        jax.device_put(g, rs), jax.device_put(h, rs),
+        jax.device_put(c, rs), meta, jnp.ones((F,), bool))
+    for name, a, b in zip(tree_s._fields, jax.tree.map(np.asarray, tree_s),
+                          jax.tree.map(np.asarray, tree_g)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"TreeArrays.{name}")
+    np.testing.assert_array_equal(np.asarray(rl_s), np.asarray(rl_g))
+
+
+def test_gspmd_fused_hybrid_categorical_identical():
+    """A categorical dataset through the hybrid: the one-vs-rest /
+    many-vs-many categorical split machinery reads the same pooled
+    histograms, so trees must stay byte-identical to serial."""
+    rng = np.random.RandomState(11)
+    bins = rng.randint(0, B, size=(N, F)).astype(np.uint8)
+    g = rng.randint(-8, 9, size=N).astype(np.float32)
+    h = rng.randint(1, 9, size=N).astype(np.float32)
+    c = np.ones(N, np.float32)
+    meta = FeatureMeta(
+        num_bin=jnp.full((F,), B, jnp.int32),
+        missing_type=jnp.zeros((F,), jnp.int32),
+        default_bin=jnp.zeros((F,), jnp.int32),
+        is_categorical=jnp.asarray([True] * 3 + [False] * (F - 3)))
+    cfg_s = _cfg(has_categorical=True, max_cat_threshold=16)
+    tree_s, rl_s = jax.jit(make_grower(cfg_s))(
+        jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+        meta, jnp.ones((F,), bool))
+    mesh = make_named_mesh(2, 4)
+    grow = make_gspmd_grower(
+        _fused_cfg(has_categorical=True, max_cat_threshold=16), mesh)
+    rs = NamedSharding(mesh, P(BATCH_AXIS))
+    tree_g, rl_g = grow(
+        jax.device_put(bins, NamedSharding(mesh, P(BATCH_AXIS, None))),
+        jax.device_put(g, rs), jax.device_put(h, rs),
+        jax.device_put(c, rs), meta, jnp.ones((F,), bool))
+    for name, a, b in zip(tree_s._fields, jax.tree.map(np.asarray, tree_s),
+                          jax.tree.map(np.asarray, tree_g)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"TreeArrays.{name}")
+    np.testing.assert_array_equal(np.asarray(rl_s), np.asarray(rl_g))
+
+
+def test_gspmd_fused_zero_recompile_across_calls():
+    """Trace-time dispatch counters pin compile behavior: growing twice
+    per mesh (fresh data, same shapes) traces the hybrid ONCE per mesh —
+    the dynamic-grid kernel and the islands introduce no shape-dependent
+    retrace."""
+    from lightgbm_tpu.obs import counters
+    counters.reset()
+    for shape in [(8, 1), (2, 4)]:
+        mesh = make_named_mesh(*shape)
+        grow = make_gspmd_grower(_fused_cfg(), mesh)
+        rs = NamedSharding(mesh, P(BATCH_AXIS))
+        for seed in (0, 1):
+            bins, g, h, c = _int_args(seed=seed)
+            binsd = jax.device_put(bins,
+                                   NamedSharding(mesh, P(BATCH_AXIS, None)))
+            jax.block_until_ready(grow(
+                binsd, jax.device_put(g, rs), jax.device_put(h, rs),
+                jax.device_put(c, rs), _meta(), jnp.ones((F,), bool))[0])
+    disp = counters.get("hist_dispatch")
+    # one trace per mesh per site: 2 meshes x {root, split}, never 4
+    assert disp == {
+        "interpret=True,method=fused,site=root": 2,
+        "interpret=True,method=fused,site=split": 2,
+    }, disp
+
+
+@pytest.mark.mesh8
+def test_gspmd_hist_fused_end_to_end_and_auto_stays_flat():
+    """Boosting-level resolution: gspmd_hist=fused engages the hybrid
+    (observed kernel identity = fused, no downgrade events), produces the
+    same predictions as the forced-flat A/B partner, and auto resolves
+    flat until the capture A/B flips it."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import counters
+    rng = np.random.RandomState(7)
+    X = rng.randn(2000, 16)
+    y = (X @ rng.randn(16) > 0).astype(np.float64)
+
+    def train(**extra):
+        params = {"objective": "binary", "verbose": -1, "num_leaves": 31,
+                  "min_data_in_leaf": 5, "tree_learner": "data", **extra}
+        return lgb.train(params, lgb.Dataset(X, label=y),
+                         num_boost_round=3, verbose_eval=False)
+
+    flat = train(gspmd_hist="flat")
+    counters.reset()
+    fused = train(gspmd_hist="fused")
+    assert fused.inner.grower_cfg.hist_method == "fused"
+    assert counters.observed_kernel() == "fused"
+    assert not counters.events("layout_downgrade")
+    np.testing.assert_allclose(fused.predict(X), flat.predict(X),
+                               rtol=2e-5, atol=2e-6)
+    auto = train()                                 # gspmd_hist defaults auto
+    assert auto.inner.grower_cfg.hist_method == "segment"
+
+
+@pytest.mark.mesh8
+def test_gspmd_hist_fused_downgrades_loudly_on_unfusable_layout():
+    """30 histogram columns do not split evenly over 8 feature shards:
+    the request must degrade to flat BEFORE labels are read — loud
+    warning + structured layout_downgrade event — and the training still
+    runs."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import counters
+    rng = np.random.RandomState(3)
+    X = rng.randn(1500, 30)
+    y = (X @ rng.randn(30) > 0).astype(np.float64)
+    counters.reset()
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 5,
+                     "tree_learner": "data", "mesh_shape": "1x8",
+                     "gspmd_hist": "fused"},
+                    lgb.Dataset(X, label=y), num_boost_round=2,
+                    verbose_eval=False)
+    assert bst.inner.grower_cfg.hist_method == "segment"
+    evs = [e for e in counters.events("layout_downgrade")
+           if e.get("requested") == "gspmd_hist=fused"]
+    assert evs and evs[0]["resolved"] == "flat", evs
+    assert "feature shards" in evs[0]["reason"], evs
+    assert np.isfinite(bst.predict(X[:10])).all()
+
+
 # ---- compiled-HLO collective audit -----------------------------------------
 
 
@@ -181,6 +349,52 @@ def test_hlo_census_data_parallel_is_plain_allreduce():
     histogram reduction is one full [F, B, 3] sum, exactly the psum the
     shard_map learner issued by hand, now compiler-inserted."""
     census = hlo_collective_census(_compile_gspmd(make_named_mesh(8, 1)))
+    full_hist = F * B * 3 * 4
+    reduces = {op: rec for op, rec in census.items()
+               if op in ("all-reduce", "reduce-scatter")}
+    assert reduces
+    assert max(r["max_bytes"] for r in reduces.values()) == full_hist
+    assert "all-gather" not in census
+
+
+def _compile_gspmd_fused(mesh):
+    bins, g, h, c = _int_args()
+    grow = make_gspmd_grower(_fused_cfg(), mesh)
+    binsd = jax.device_put(bins, NamedSharding(mesh, P(BATCH_AXIS, None)))
+    rs = NamedSharding(mesh, P(BATCH_AXIS))
+    return grow.lower(binsd, jax.device_put(g, rs), jax.device_put(h, rs),
+                      jax.device_put(c, rs), _meta(),
+                      jnp.ones((F,), bool)).compile()
+
+
+def test_hlo_census_fused_hybrid_no_rowshard_or_pool_allgather():
+    """Hybrid acceptance audit (2x4): the island boundary must not make
+    the partitioner materialize anyone else's rows or histograms — no
+    all-gather reaches a full row shard (the panel stays device-local) or
+    a full leaf histogram, and the cross-shard reduction payload is at
+    most the feature shard's slice, exactly the flat path's scattered
+    contract."""
+    census = hlo_collective_census(_compile_gspmd_fused(make_named_mesh(2, 4)))
+    full_hist = F * B * 3 * 4            # one leaf's [F, B, 3] f32
+    slice_hist = full_hist // 4          # the feature shard's slice
+    row_shard = (N // 2) * F             # one device's u8 bin rows
+    reduces = {op: rec for op, rec in census.items()
+               if op in ("all-reduce", "reduce-scatter")}
+    assert reduces, f"no histogram reduction collective found: {census}"
+    assert max(r["max_bytes"] for r in reduces.values()) <= slice_hist, (
+        f"hybrid reduction moves more than the feature shard's slice "
+        f"({slice_hist} B): {census}")
+    ag = census.get("all-gather", {"max_bytes": 0})
+    assert ag["max_bytes"] < min(full_hist, row_shard), (
+        f"an all-gather re-materializes a row shard or a full histogram: "
+        f"{census}")
+
+
+def test_hlo_census_fused_hybrid_data_parallel():
+    """Hybrid on pure data-parallel (8x1): one full [F, B, 3] cross-batch
+    sum of the island partials — the compiler-inserted psum — and no
+    all-gather anywhere."""
+    census = hlo_collective_census(_compile_gspmd_fused(make_named_mesh(8, 1)))
     full_hist = F * B * 3 * 4
     reduces = {op: rec for op, rec in census.items()
                if op in ("all-reduce", "reduce-scatter")}

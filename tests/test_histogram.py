@@ -1,13 +1,23 @@
 """Histogram kernel parity — the reference's GPU_DEBUG_COMPARE discipline
 (gpu_tree_learner.cpp:1018-1043) as a real test: every backend path must
-produce identical histograms, including sentinel-padded gather rows."""
+produce identical histograms, including sentinel-padded gather rows.
+
+Since the gen-1 Pallas kernels were retired (round 9), the dispatch
+ladder has exactly one Pallas rung — the fused gather-histogram kernel —
+verified here in interpret mode against the einsum oracle and the numpy
+reference, in both its order-window form (serial grower) and its
+shard-local row_leaf form (the GSPMD hybrid)."""
 import numpy as np
 import pytest
 
 import jax.numpy as jnp
 
-from lightgbm_tpu.ops.histogram import (_split_hi_lo, subset_histogram_einsum)
-from lightgbm_tpu.ops.pallas_hist import subset_histogram_pallas
+from lightgbm_tpu.data.packing import pack_fused_panel
+from lightgbm_tpu.ops.histogram import (_split_hi_lo,
+                                        subset_histogram_einsum,
+                                        subset_histogram_fused,
+                                        subset_histogram_fused_local)
+from lightgbm_tpu.ops.pallas_hist import fused_idx_fetch
 
 
 @pytest.fixture(scope="module")
@@ -37,6 +47,28 @@ def _numpy_reference(rows, g, h, c, b):
     return out
 
 
+def _panel(rows, g, h, c):
+    """Sentinel-pad one zero row then pack (the grower's contract: the
+    panel's last row must read zeros for redirected tail positions)."""
+    zrow = np.zeros((1, rows.shape[1]), rows.dtype)
+    zw = np.zeros((1,), np.float32)
+    return pack_fused_panel(jnp.asarray(np.concatenate([rows, zrow])),
+                            jnp.asarray(np.concatenate([g, zw])),
+                            jnp.asarray(np.concatenate([h, zw])),
+                            jnp.asarray(np.concatenate([c, zw])))
+
+
+def _fused(rows, g, h, c, b, row_tile=512):
+    m, f = rows.shape
+    panel, per = _panel(rows, g, h, c)
+    order = np.concatenate([np.arange(m, dtype=np.int32),
+                            np.full((fused_idx_fetch(row_tile),), m,
+                                    np.int32)])
+    return np.asarray(subset_histogram_fused(
+        jnp.asarray(order), panel, 0, m, f, per, b, row_tile=row_tile,
+        num_row_tiles=-(-m // row_tile), interpret=True))
+
+
 def test_einsum_matches_numpy(problem):
     rows, g, h, c, b, real = problem
     ref = _numpy_reference(rows, g, h, c, b)
@@ -59,13 +91,11 @@ def test_segment_matches_numpy(problem):
     assert out[:, :, 2].sum(axis=1) == pytest.approx(c.sum())
 
 
-def test_pallas_matches_einsum_interpret(problem):
+def test_fused_matches_einsum_interpret(problem):
     rows, g, h, c, b, real = problem
     a = np.asarray(subset_histogram_einsum(
         jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c), b))
-    p = np.asarray(subset_histogram_pallas(
-        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
-        b, feat_tile=4, row_tile=512, interpret=True))
+    p = _fused(rows, g, h, c, b)
     # bf16 hi/lo split: ~2^-17 relative error on the g/h sums, counts exact
     np.testing.assert_allclose(p, a, rtol=3e-4, atol=3e-4)
     np.testing.assert_array_equal(p[:, :, 2], a[:, :, 2])
@@ -80,8 +110,9 @@ def test_hi_lo_split_accuracy():
     np.testing.assert_allclose(np.asarray(rec), np.asarray(x), rtol=1e-5)
 
 
-def test_pallas_odd_sizes_interpret():
-    """F and M not multiples of the tile sizes exercise the padding path."""
+def test_fused_odd_sizes_interpret():
+    """F and M not multiples of the tile/pack-group sizes exercise the
+    column zero-pad and the partial last row tile."""
     rng = np.random.RandomState(2)
     m, f, b = 700, 5, 16
     rows = rng.randint(0, b, size=(m, f)).astype(np.uint8)
@@ -89,36 +120,13 @@ def test_pallas_odd_sizes_interpret():
     h = np.ones(m, np.float32)
     c = np.ones(m, np.float32)
     ref = _numpy_reference(rows, g, h, c, b)
-    p = np.asarray(subset_histogram_pallas(
-        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
-        b, feat_tile=4, row_tile=512, interpret=True))
+    p = _fused(rows, g, h, c, b)
     np.testing.assert_allclose(p, ref, rtol=3e-4, atol=3e-4)
 
 
-def test_pallas_nibble_matches_einsum_interpret():
-    """The hi/lo nibble-factorized kernel (B_pad = 256) must agree with the
-    f32 einsum oracle bin for bin, counts exactly."""
-    rng = np.random.RandomState(4)
-    m, f, b = 2048, 16, 255
-    real = 1500
-    rows = rng.randint(0, b, size=(m, f)).astype(np.uint8)
-    g = rng.randn(m).astype(np.float32)
-    h = np.abs(rng.randn(m)).astype(np.float32)
-    c = (rng.rand(m) > 0.1).astype(np.float32)
-    g[real:] = 0.0
-    h[real:] = 0.0
-    c[real:] = 0.0
-    a = np.asarray(subset_histogram_einsum(
-        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c), b))
-    p = np.asarray(subset_histogram_pallas(
-        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
-        b, feat_tile=8, row_tile=512, interpret=True, impl="nibble"))
-    np.testing.assert_allclose(p, a, rtol=3e-4, atol=3e-4)
-    np.testing.assert_array_equal(p[:, :, 2], a[:, :, 2])
-
-
-def test_pallas_nibble_full_256_bins():
-    """num_bins = 256 exactly (no phantom-bin slice) through the nibble path."""
+def test_fused_full_256_bins():
+    """num_bins = 256 exactly (no phantom-bin slice) — the packed-layout
+    joint-histogram width."""
     rng = np.random.RandomState(5)
     m, f, b = 1024, 8, 256
     rows = rng.randint(0, b, size=(m, f)).astype(np.uint8)
@@ -126,7 +134,28 @@ def test_pallas_nibble_full_256_bins():
     h = np.ones(m, np.float32)
     c = np.ones(m, np.float32)
     ref = _numpy_reference(rows, g, h, c, b)
-    p = np.asarray(subset_histogram_pallas(
-        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
-        b, feat_tile=8, row_tile=512, interpret=True, impl="nibble"))
+    p = _fused(rows, g, h, c, b)
     np.testing.assert_allclose(p, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_fused_local_matches_einsum_interpret():
+    """The shard-local form (GSPMD hybrid entry): membership arrives as a
+    row -> leaf partition instead of a maintained order window, and the
+    kernel must histogram exactly the rows matching ``leaf_id``."""
+    rng = np.random.RandomState(4)
+    m, f, b = 2048, 16, 255
+    rows = rng.randint(0, b, size=(m, f)).astype(np.uint8)
+    g = rng.randn(m).astype(np.float32)
+    h = np.abs(rng.randn(m)).astype(np.float32)
+    c = np.ones(m, np.float32)
+    row_leaf = rng.randint(0, 3, size=m).astype(np.int32)
+    panel, per = _panel(rows, g, h, c)
+    for leaf in (0, 1, 2):
+        mask = (row_leaf == leaf).astype(np.float32)
+        a = np.asarray(subset_histogram_einsum(
+            jnp.asarray(rows), jnp.asarray(g * mask), jnp.asarray(h * mask),
+            jnp.asarray(c * mask), b))
+        p = np.asarray(subset_histogram_fused_local(
+            jnp.asarray(row_leaf), leaf, panel, f, per, b, interpret=True))
+        np.testing.assert_allclose(p, a, rtol=3e-4, atol=3e-4)
+        np.testing.assert_array_equal(p[:, :, 2], a[:, :, 2])

@@ -51,34 +51,11 @@ def v5e():
         jax.config.update("jax_compilation_cache_dir", prev_cache_dir)
 
 
-@pytest.mark.xfail(
-    strict=False,
-    reason="gen-1 Pallas hist kernels (onehot + nibble) no longer "
-           "Mosaic-lower on the current jax 0.4.37 + bundled libtpu image "
-           "(the 3-D one-hot reshape class) — KNOWN toolchain regression, "
-           "quarantined so new lowering breakage is distinguishable; see "
-           "ROADMAP.md open item 'Gen-1 Pallas kernels no longer "
-           "Mosaic-lower'.  The gen-2 fused kernel below is the "
-           "lowering-proven path.")
-@pytest.mark.parametrize("impl,num_bins,f", [
-    ("onehot", 255, 28), ("onehot", 63, 28), ("onehot", 255, 2000),
-    ("nibble", 255, 28), ("nibble", 255, 2000),
-])
-def test_hist_kernel_lowers(v5e, impl, num_bins, f):
-    import jax.numpy as jnp
-    from lightgbm_tpu.ops.pallas_hist import subset_histogram_pallas
-    m = 2048
-    fn = jax.jit(lambda r, g, h, c: subset_histogram_pallas(
-        r, g, h, c, num_bins, impl=impl))
-    fn.lower(v5e((m, f), jnp.int32), v5e((m,), jnp.float32),
-             v5e((m,), jnp.float32), v5e((m,), jnp.float32)).compile()
-
-
 @pytest.mark.parametrize("dyn_grid,num_bins,f", [
     (False, 255, 28), (True, 255, 28), (True, 63, 28), (True, 256, 12),
 ])
 def test_fused_hist_kernel_lowers(v5e, dyn_grid, num_bins, f):
-    """The gen-2 fused-gather kernel Mosaic-compiles for v5e: in-kernel
+    """The fused-gather kernel Mosaic-compiles for v5e: in-kernel
     index fetch (aligned over-read), per-row panel DMA, nibble
     contraction — with both static and DYNAMIC (traced tile count) grids.
     Offline runs of this proof caught FIVE real lowering failures that
@@ -150,10 +127,8 @@ FULL_GROWER_PROOFS = pytest.mark.skipif(
     {"ordered_bins": "on", "partition_impl": "sort"},
     {"partition_impl": "compact", "gather_words": "on"},
     {"partition_impl": "compact", "ordered_bins": "on"},
-    {"gather_words": "on", "hist_impl": "nibble"},
     {"gather_words": "on", "bucket_scheme": "pow15"},
-], ids=["defaults", "ordered_sort", "compact", "compact_ordered",
-        "nibble", "pow15"])
+], ids=["defaults", "ordered_sort", "compact", "compact_ordered", "pow15"])
 def test_full_grower_lowers(v5e, knobs):
     """Every capture-playbook A/B configuration of the FULL grower
     (gather buckets, lax.switch, while_loop, Pallas kernels) must
@@ -163,7 +138,7 @@ def test_full_grower_lowers(v5e, knobs):
     n, f = 1 << 17, 28
     cfg = GrowerConfig(num_leaves=255, min_data_in_leaf=1,
                        min_sum_hessian_in_leaf=100.0, max_bin=255,
-                       hist_method="pallas", **knobs)
+                       hist_method="fused", **knobs)
     meta = FeatureMeta(
         num_bin=v5e((f,), jnp.int32), missing_type=v5e((f,), jnp.int32),
         default_bin=v5e((f,), jnp.int32),
@@ -179,13 +154,15 @@ def test_full_grower_lowers_wide(v5e):
     """Epsilon-wide (F=2000) grower Mosaic-compiles — the capture's wide
     coverage stage cannot be lost to a lowering surprise (measured ~96 s
     to compile on the 1-core host; budget the in-window remote compile
-    accordingly)."""
+    accordingly).  F=2000 exceeds the fused kernel's column ceiling, so
+    the TPU ladder lands on the einsum reference — compile exactly that
+    program."""
     import jax.numpy as jnp
     from lightgbm_tpu.grower import FeatureMeta, GrowerConfig, make_grower
     n, f = 1 << 17, 2000
     cfg = GrowerConfig(num_leaves=255, min_data_in_leaf=1,
                        min_sum_hessian_in_leaf=100.0, max_bin=255,
-                       hist_method="pallas", gather_words="on")
+                       hist_method="einsum", gather_words="on")
     meta = FeatureMeta(
         num_bin=v5e((f,), jnp.int32), missing_type=v5e((f,), jnp.int32),
         default_bin=v5e((f,), jnp.int32),
@@ -218,7 +195,7 @@ def test_distributed_grower_lowers_4chip(learner):
     devs = np.array(topo.devices)
     cfg = GrowerConfig(num_leaves=63, min_data_in_leaf=1,
                        min_sum_hessian_in_leaf=100.0, max_bin=255,
-                       hist_method="pallas", gather_words="on")
+                       hist_method="fused", gather_words="on")
     n, f = 1 << 16, 32
     if learner == "data_feature":
         mesh = Mesh(devs.reshape(2, 2), ("data", "feature"))
@@ -245,6 +222,48 @@ def test_distributed_grower_lowers_4chip(learner):
              meta, arg((f,), jnp.bool_, P())).compile()
 
 
+def test_gspmd_fused_hybrid_lowers_4chip():
+    """The gspmd_hist=fused hybrid — shard_map pack + kernel islands
+    inside the compiler-partitioned grow program — Mosaic-compiles for a
+    REAL 4-chip v5e topology (2x2 batch x feature mesh): the strongest
+    offline evidence that the island boundary, the per-shard fused
+    kernel, and the partitioner-owned cross-shard reduction compose
+    through actual TPU lowering."""
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig
+    from lightgbm_tpu.parallel.gspmd import make_gspmd_grower
+    from lightgbm_tpu.parallel.mesh import BATCH_AXIS, FEATURE_AXIS
+    try:
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name="v5e:2x2")
+    except Exception as e:
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    devs = np.array(topo.devices).reshape(2, 2)
+    mesh = Mesh(devs, (BATCH_AXIS, FEATURE_AXIS))
+    cfg = GrowerConfig(num_leaves=63, min_data_in_leaf=1,
+                       min_sum_hessian_in_leaf=100.0, max_bin=255,
+                       hist_method="fused")
+    n, f = 1 << 16, 32
+    grow = make_gspmd_grower(cfg, mesh)
+
+    def arg(shape, dtype, spec):
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=NamedSharding(mesh, spec))
+    meta = FeatureMeta(
+        num_bin=arg((f,), jnp.int32, P()),
+        missing_type=arg((f,), jnp.int32, P()),
+        default_bin=arg((f,), jnp.int32, P()),
+        is_categorical=arg((f,), jnp.bool_, P()))
+    grow.lower(arg((n, f), jnp.uint8, P(BATCH_AXIS, None)),
+               arg((n,), jnp.float32, P(BATCH_AXIS)),
+               arg((n,), jnp.float32, P(BATCH_AXIS)),
+               arg((n,), jnp.float32, P(BATCH_AXIS)),
+               meta, arg((f,), jnp.bool_, P())).compile()
+
+
 def test_packed_grower_lowers(v5e):
     """The bin-packing composition (packed storage matrix + joint 256-bin
     Pallas histograms + unfold) Mosaic-compiles — the sparse capture
@@ -260,7 +279,7 @@ def test_packed_grower_lowers(v5e):
     n = 1 << 16
     cfg = GrowerConfig(num_leaves=63, min_data_in_leaf=1,
                        min_sum_hessian_in_leaf=100.0, max_bin=255,
-                       hist_method="pallas", gather_words="on")
+                       hist_method="fused", gather_words="on")
     meta = FeatureMeta(
         num_bin=v5e((f,), jnp.int32), missing_type=v5e((f,), jnp.int32),
         default_bin=v5e((f,), jnp.int32),

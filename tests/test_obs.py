@@ -185,8 +185,10 @@ def test_counter_registry_resets_between_trainings(tmp_path):
     assert second == first
 
 
-def test_dispatch_identity_einsum_vs_interpret_pallas():
-    from lightgbm_tpu.ops.histogram import subset_histogram
+def test_dispatch_identity_einsum_vs_interpret_fused():
+    from lightgbm_tpu.data.packing import pack_fused_panel
+    from lightgbm_tpu.ops.histogram import (subset_histogram,
+                                            subset_histogram_fused_local)
     rng = np.random.RandomState(3)
     rows = rng.randint(0, 16, size=(256, 8)).astype(np.uint8)
     g = rng.randn(256).astype(np.float32)
@@ -199,15 +201,20 @@ def test_dispatch_identity_einsum_vs_interpret_pallas():
         "interpret=False,method=einsum,site=t": 1}
 
     counters.reset()
-    h_p = subset_histogram(rows, g, h, c, 16, method="pallas",
-                           interpret=True, site="t")
-    assert counters.observed_kernel() == "pallas"
+    zrow = np.zeros((1, 8), np.uint8)
+    zw = np.zeros((1,), np.float32)
+    panel, per = pack_fused_panel(np.concatenate([rows, zrow]),
+                                  np.concatenate([g, zw]),
+                                  np.concatenate([h, zw]),
+                                  np.concatenate([c, zw]))
+    row_leaf = np.zeros(256, np.int32)
+    h_f = subset_histogram_fused_local(row_leaf, 0, panel, 8, per, 16,
+                                       interpret=True, site="t")
+    assert counters.observed_kernel() == "fused"
     assert counters.get("hist_dispatch") == {
-        "interpret=True,method=pallas,site=t": 1}
-    # the kernel FORM resolved under method=pallas is counted too
-    assert counters.get("pallas_impl") == {"impl=onehot": 1}
-    # pallas accumulates in bf16 hi/lo pairs (~f32 accuracy, not exact)
-    np.testing.assert_allclose(np.asarray(h_e), np.asarray(h_p),
+        "interpret=True,method=fused,site=t": 1}
+    # fused accumulates in bf16 hi/lo pairs (~f32 accuracy, not exact)
+    np.testing.assert_allclose(np.asarray(h_e), np.asarray(h_f),
                                rtol=1e-3, atol=1e-4)
 
 

@@ -84,14 +84,16 @@ def test_unfold_matches_direct_histogram():
         np.testing.assert_allclose(out[f], direct, rtol=1e-6, atol=1e-5)
 
 
-def test_unfold_composes_with_pallas_kernel_interpret():
-    """The TPU path histograms PACKED storage columns with the Pallas
-    kernel at the 256-wide joint index; interpret mode pins that
+def test_unfold_composes_with_fused_kernel_interpret():
+    """The TPU path histograms PACKED storage columns with the fused
+    Pallas kernel at the 256-wide joint index; interpret mode pins that
     combination (kernel x packing) without a chip: joint histograms
     from the kernel, unfolded, must equal per-feature histograms
     computed directly."""
     import jax.numpy as jnp
-    from lightgbm_tpu.ops.pallas_hist import subset_histogram_pallas
+    from lightgbm_tpu.data.packing import pack_fused_panel
+    from lightgbm_tpu.ops.histogram import subset_histogram_fused
+    from lightgbm_tpu.ops.pallas_hist import fused_idx_fetch
     rng = np.random.RandomState(2)
     nb = [255, 9, 16, 5, 13]
     n = 600
@@ -102,10 +104,18 @@ def test_unfold_composes_with_pallas_kernel_interpret():
     c = np.ones(n, np.float32)
     plan = build_pack_plan(nb)
     packed = pack_columns(binned, plan)
-    hist_c = subset_histogram_pallas(jnp.asarray(packed), jnp.asarray(g),
-                                     jnp.asarray(h), jnp.asarray(c), 256,
-                                     feat_tile=2, row_tile=512,
-                                     interpret=True)
+    zrow = np.zeros((1, packed.shape[1]), packed.dtype)
+    zw = np.zeros((1,), np.float32)
+    panel, per = pack_fused_panel(
+        jnp.asarray(np.concatenate([packed, zrow])),
+        jnp.asarray(np.concatenate([g, zw])),
+        jnp.asarray(np.concatenate([h, zw])),
+        jnp.asarray(np.concatenate([c, zw])))
+    order = np.concatenate([np.arange(n, dtype=np.int32),
+                            np.full((fused_idx_fetch(512),), n, np.int32)])
+    hist_c = subset_histogram_fused(
+        jnp.asarray(order), panel, 0, n, packed.shape[1], per, 256,
+        row_tile=512, num_row_tiles=-(-n // 512), interpret=True)
     out = np.asarray(unfold_packed_hist(hist_c, plan, 255))
     w = np.stack([g, h, c], axis=1)
     for f in range(len(nb)):

@@ -22,48 +22,6 @@ def tpu():
     return jax.devices()[0]
 
 
-@pytest.mark.parametrize("num_bins,f", [(63, 28), (255, 28), (255, 2000),
-                                        (63, 2000)])
-def test_pallas_hist_compiles_on_tpu(tpu, num_bins, f):
-    """Mosaic lowering smoke test at the bench-relevant shapes."""
-    import jax
-    import jax.numpy as jnp
-    from lightgbm_tpu.ops.pallas_hist import subset_histogram_pallas
-
-    m = 2048
-    fn = jax.jit(lambda r, g, h, c: subset_histogram_pallas(
-        r, g, h, c, num_bins))
-    args = (jnp.zeros((m, f), jnp.int32), jnp.zeros((m,), jnp.float32),
-            jnp.zeros((m,), jnp.float32), jnp.zeros((m,), jnp.float32))
-    fn.lower(*args).compile()     # Mosaic failure raises here
-
-
-@pytest.mark.parametrize("num_bins", [63, 255])
-def test_pallas_matches_einsum_on_device(tpu, num_bins):
-    """On-device numerical parity pallas vs f32 einsum (counts exact,
-    g/h within the bf16 hi/lo-split envelope)."""
-    import jax.numpy as jnp
-    from lightgbm_tpu.ops.histogram import subset_histogram_einsum
-    from lightgbm_tpu.ops.pallas_hist import subset_histogram_pallas
-
-    rng = np.random.RandomState(0)
-    m, f = 4096, 28
-    rows = rng.randint(0, num_bins, size=(m, f)).astype(np.int32)
-    g = rng.randn(m).astype(np.float32)
-    h = np.abs(rng.randn(m)).astype(np.float32)
-    c = (rng.rand(m) > 0.1).astype(np.float32)
-    g[c == 0] = 0.0
-    h[c == 0] = 0.0
-    hp = np.asarray(subset_histogram_pallas(
-        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
-        num_bins))
-    he = np.asarray(subset_histogram_einsum(
-        jnp.asarray(rows), jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
-        num_bins))
-    np.testing.assert_array_equal(hp[:, :, 2], he[:, :, 2])
-    np.testing.assert_allclose(hp, he, rtol=3e-4, atol=3e-4)
-
-
 @pytest.mark.parametrize("num_bins,leaves", [(63, 31), (255, 255)])
 def test_grow_tree_compiles_on_tpu(tpu, num_bins, leaves):
     """The FULL jitted grower (gather buckets, lax.switch, while_loop,
@@ -75,7 +33,7 @@ def test_grow_tree_compiles_on_tpu(tpu, num_bins, leaves):
     n, f = 1 << 15, 28
     cfg = GrowerConfig(num_leaves=leaves, min_data_in_leaf=1,
                        min_sum_hessian_in_leaf=100.0, max_bin=num_bins,
-                       hist_method="pallas", bucket_min_log2=10)
+                       hist_method="fused", bucket_min_log2=10)
     meta = FeatureMeta(
         num_bin=jnp.full((f,), num_bins, jnp.int32),
         missing_type=jnp.zeros((f,), jnp.int32),
@@ -140,55 +98,29 @@ def test_packed_training_matches_unpacked_on_tpu(tpu):
         np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
 
 
-@pytest.mark.parametrize("num_bins,f", [(255, 28), (255, 2000)])
-def test_pallas_nibble_compiles_on_tpu(tpu, num_bins, f):
-    """Mosaic lowering smoke for the hi/lo nibble-factorized kernel — the
-    gate for flipping hist6_pallas 'auto' to nibble at B_pad = 256."""
+def test_gspmd_fused_hybrid_matches_flat_on_tpu(tpu):
+    """gspmd_hist=fused (shard_map islands + Mosaic kernel) vs flat
+    (pure-XLA scatter-add) over the real device mesh: structure-identical
+    models — the on-chip half of the CPU byte-identity pins in
+    tests/test_gspmd.py, with live Mosaic lowering and bf16 numerics."""
     import jax
-    import jax.numpy as jnp
-    from lightgbm_tpu.ops.pallas_hist import subset_histogram_pallas
-
-    m = 2048
-    fn = jax.jit(lambda r, g, h, c: subset_histogram_pallas(
-        r, g, h, c, num_bins, impl="nibble"))
-    args = (jnp.zeros((m, f), jnp.int32), jnp.zeros((m,), jnp.float32),
-            jnp.zeros((m,), jnp.float32), jnp.zeros((m,), jnp.float32))
-    fn.lower(*args).compile()
-
-
-def test_pallas_nibble_matches_onehot_on_device(tpu):
-    """On-device: nibble and onehot kernels agree bin for bin at 255 bins."""
-    import jax
-    import jax.numpy as jnp
-    import time
-    from lightgbm_tpu.ops.pallas_hist import subset_histogram_pallas
-
-    rng = np.random.RandomState(6)
-    m, f, b = 1 << 17, 28, 255
-    rows = jnp.asarray(rng.randint(0, b, size=(m, f)).astype(np.int32))
-    g = jnp.asarray(rng.randn(m).astype(np.float32))
-    h = jnp.asarray(np.abs(rng.randn(m)).astype(np.float32))
-    c = jnp.asarray(np.ones(m, np.float32))
-    fns = {}
-    for impl in ("onehot", "nibble"):
-        fns[impl] = jax.jit(lambda r, gg, hh, cc, i=impl:
-                            subset_histogram_pallas(r, gg, hh, cc, b, impl=i))
-        jax.block_until_ready(fns[impl](rows, g, h, c))
-    a = np.asarray(fns["onehot"](rows, g, h, c))
-    p = np.asarray(fns["nibble"](rows, g, h, c))
-    np.testing.assert_array_equal(p[:, :, 2], a[:, :, 2])
-    np.testing.assert_allclose(p, a, rtol=3e-4, atol=3e-4)
-    # throughput head-to-head goes to stderr for the capture log
-    import sys
-    for impl, fn in fns.items():
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(5):
-            out = fn(rows, g, h, c)
-        jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / 5
-        print(f"hist {impl}: {dt*1e3:.2f} ms at {m} rows "
-              f"({dt/m*1e9:.1f} ns/row)", file=sys.stderr)
+    import lightgbm_tpu as lgb
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device TPU slice")
+    rng = np.random.RandomState(11)
+    n, f = 50_000, 16
+    X = rng.randn(n, f).astype(np.float32)
+    y = ((X @ rng.randn(f)) > 0).astype(np.float32)
+    out = {}
+    for gh in ("flat", "fused"):
+        params = dict(objective="binary", num_leaves=31, max_bin=255,
+                      min_data_in_leaf=20, learning_rate=0.1, verbose=-1,
+                      tree_learner="data", gspmd_hist=gh)
+        out[gh] = lgb.train(params, lgb.Dataset(X, label=y),
+                            num_boost_round=5)
+    for t1, t2 in zip(out["flat"].inner.models, out["fused"].inner.models):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
 
 
 def test_pallas_compact_compiles_and_matches_on_tpu(tpu):
@@ -239,19 +171,20 @@ def test_pallas_compact_compiles_and_matches_on_tpu(tpu):
           f"({dt/size*1e9:.1f} ns/row)", file=sys.stderr)
 
 
-def test_fused_hist_matches_gen1_on_device(tpu):
-    """On-device proof of the gen-2 fused-gather kernel: compiles under
-    Mosaic, matches the gen-1 pallas kernel over the same gathered window
-    (counts exact), and prints the head-to-head throughput for the
-    capture log — the number that decides pallas_fused auto->on."""
+def test_fused_hist_matches_einsum_on_device(tpu):
+    """On-device proof of the fused-gather kernel: compiles under Mosaic,
+    matches the f32 einsum oracle over the same gathered window (counts
+    exact, g/h within the bf16 hi/lo-split envelope), and prints the
+    throughput for the capture log — the number that decides
+    pallas_fused auto->on."""
     import sys
     import time
     import jax
     import jax.numpy as jnp
     from lightgbm_tpu.data.packing import pack_fused_panel
-    from lightgbm_tpu.ops.histogram import subset_histogram_fused
-    from lightgbm_tpu.ops.pallas_hist import (fused_idx_fetch,
-                                              subset_histogram_pallas)
+    from lightgbm_tpu.ops.histogram import (subset_histogram_einsum,
+                                            subset_histogram_fused)
+    from lightgbm_tpu.ops.pallas_hist import fused_idx_fetch
 
     rng = np.random.RandomState(8)
     n, f, b, tr = 1 << 17, 28, 255, 512
@@ -273,15 +206,14 @@ def test_fused_hist_matches_gen1_on_device(tpu):
         o, p, s, ct, f, per, b, row_tile=tr, num_row_tiles=nt))
     out = np.asarray(fused(order, panel, start, cnt))
     sel = perm[start:start + cnt]
-    gen1 = jax.jit(lambda r, gg, hh, cc: subset_histogram_pallas(
+    oracle = jax.jit(lambda r, gg, hh, cc: subset_histogram_einsum(
         r, gg, hh, cc, b))
-    ref = np.asarray(gen1(jnp.asarray(bins[sel]), jnp.asarray(g[sel]),
-                          jnp.asarray(h[sel]), jnp.asarray(c[sel])))
+    ref = np.asarray(oracle(jnp.asarray(bins[sel]), jnp.asarray(g[sel]),
+                            jnp.asarray(h[sel]), jnp.asarray(c[sel])))
     np.testing.assert_array_equal(out[:, :, 2], ref[:, :, 2])
     np.testing.assert_allclose(out, ref, rtol=3e-4, atol=3e-4)
-    # throughput: fused (gather in-kernel) vs gen-1 (hist only, gather
-    # already paid outside) — fused must be judged against hist + the
-    # ~12.6 ns/row external gather it absorbs
+    # throughput: fused gathers in-kernel, so judge it against any
+    # hist-only rung + the ~12.6 ns/row external gather it absorbs
     args = (order, panel, jnp.asarray(start, jnp.int32),
             jnp.asarray(cnt, jnp.int32))
     fused_dyn = jax.jit(lambda o, p, s, ct: subset_histogram_fused(
