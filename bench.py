@@ -563,6 +563,7 @@ def child_main():
     from lightgbm_tpu.data.dataset import construct
     from lightgbm_tpu.objectives import create_objective
     from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.obs import devprof as obs_devprof
     from lightgbm_tpu.obs import memory as obs_memory
     from lightgbm_tpu.obs import trace as obs_trace
     from lightgbm_tpu.obs.counters import counters as obs_counters
@@ -575,9 +576,24 @@ def child_main():
     # JSON carries a "memory" block (predicted + measured peak bytes)
     obs_counters.reset()
     bench_trace = os.environ.get("BENCH_TRACE", "")
-    if bench_trace:
-        obs_trace.start(bench_trace)
+    # device-time attribution (obs/devprof.py): armed rungs capture
+    # profiler windows over dedicated un-timed steady iterations (below)
+    # and embed the device_profile block; needs the tracer's
+    # TraceAnnotation phase windows, so tracing arms alongside
+    devprof_armed = os.environ.get("BENCH_DEVICE_PROFILE", "") == "1"
+    profile_iters = int(os.environ.get("BENCH_PROFILE_ITERS", "2") or 2)
+    if bench_trace or devprof_armed:
+        obs_trace.start(bench_trace or None)
     obs_memory.start()
+    if devprof_armed:
+        obs_devprof.start(profile_iters=profile_iters)
+    # a skipped TPU (probe failure in the parent) is first-class evidence:
+    # the counter rides the embedded metrics_snapshot / any live scrape as
+    # lgbm_tpu_probe_failed_total, and bench_history counts the streaks
+    if os.environ.get("BENCH_TPU_SKIPPED"):
+        obs_counters.inc("probe_failed", stage="tpu_probe")
+        obs_counters.event("probe_failed", stage="tpu_probe",
+                           detail=os.environ["BENCH_TPU_SKIPPED"][:200])
     platform = jax.devices()[0].platform
     params = {
         "objective": "binary",
@@ -610,6 +626,15 @@ def child_main():
     booster.train_one_iter()          # warmup (compile)
     jax.block_until_ready(booster.scores)
     sys.stderr.write(f"bench: warmup (compile) {time.perf_counter() - t0:.1f}s\n")
+    if devprof_armed:
+        # devprof windows run over DEDICATED steady iterations so the
+        # capture/parse overhead never perturbs the timed loop below
+        t0 = time.perf_counter()
+        for _ in range(profile_iters):
+            booster.train_one_iter()
+        jax.block_until_ready(booster.scores)
+        sys.stderr.write(f"bench: devprof capture ({profile_iters} iters) "
+                         f"{time.perf_counter() - t0:.1f}s\n")
     t0 = time.perf_counter()
     for _ in range(n_timed):
         booster.train_one_iter()
@@ -639,6 +664,15 @@ def child_main():
     # leaves-sweep micro-rung trains its extra (possibly chain-forced A/B)
     # boosters into the same counter registry
     split_find_counts = obs_counters.get("split_find_dispatch")
+
+    # device-time attribution block, finalized BEFORE the micro-rungs so
+    # it describes the measured training only (obs/devprof.py)
+    device_profile = obs_devprof.stop() if devprof_armed else None
+    if device_profile is not None:
+        sys.stderr.write(
+            f"bench: devprof captured={device_profile['captured_iterations']}"
+            f" attributed={device_profile['attributed_fraction']}"
+            f" phases={json.dumps(device_profile['phase_device_ms'])}\n")
 
     # device-memory evidence, also snapshotted BEFORE the leaves sweep so
     # its extra boosters never inflate the measured number: the predicted
@@ -743,6 +777,12 @@ def child_main():
         "memory": memory_block,
         "metrics_snapshot": metrics_snapshot,
     }
+    if device_profile is not None:
+        result["device_profile"] = device_profile
+        devprof_out = os.environ.get("BENCH_DEVPROF", "")
+        if devprof_out:        # capture scripts collect these per rung
+            with open(devprof_out, "w") as f:
+                json.dump(device_profile, f)
     if leaves_sweep is not None:
         result["leaves_sweep"] = leaves_sweep
     if serving is not None:
@@ -793,9 +833,38 @@ def _rung_label(platform: str, mode: str) -> str:
     return f"{platform}+{mode}" if mode == "fused" else platform
 
 
+_NOISE_MARKERS = (
+    # the LLVM cpu-feature dump (one multi-thousand-char line; BENCH_r05
+    # banked it as the entire scheduled-run tail)
+    "vs host machine features",
+    "This could lead to execution errors",
+)
+_MAX_STDERR_LINE = 400
+
+
+def _clean_stderr(err: str, limit: int = 4000) -> str:
+    """Bound child stderr before passthrough: the scheduled driver banks
+    only the LAST 2000 chars of output, so one unbounded diagnostic line
+    can evict every real signal.  Known-noise lines are dropped (with a
+    stub naming what was dropped), any line is capped, the total bounded."""
+    lines = []
+    for ln in (err or "").splitlines():
+        if any(m in ln for m in _NOISE_MARKERS):
+            lines.append(f"[{len(ln)}-char diagnostic dropped: "
+                         f"{ln[:80]}...]")
+            continue
+        if len(ln) > _MAX_STDERR_LINE:
+            ln = (ln[:_MAX_STDERR_LINE]
+                  + f" ...[{len(ln) - _MAX_STDERR_LINE} chars truncated]")
+        lines.append(ln)
+    out = "\n".join(lines)
+    return out[-limit:]
+
+
 def _run_child(platform: str, mode: str, timeout_s: int):
-    """One rung of the fallback ladder.  Returns the parsed JSON dict or an
-    error string."""
+    """One rung of the fallback ladder.  Returns ``(result, rung_record)``:
+    the parsed JSON dict (or an error string) plus the bounded structured
+    ``{rung, rc, tail}`` record the runner block aggregates."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CHILD_PLATFORM"] = platform
@@ -810,20 +879,39 @@ def _run_child(platform: str, mode: str, timeout_s: int):
         if e.stderr:
             err = e.stderr if isinstance(e.stderr, str) else e.stderr.decode(
                 "utf-8", "replace")
-            sys.stderr.write(err[-4000:])
-            tail = " last stderr: " + err.strip()[-200:].replace("\n", " | ")
-        return f"{label}: timeout {timeout_s}s{tail}"
-    sys.stderr.write(r.stderr[-4000:])
+            sys.stderr.write(_clean_stderr(err))
+            tail = " last stderr: " + _clean_stderr(err.strip(), 200) \
+                .replace("\n", " | ")
+        rung = {"rung": label, "rc": None,
+                "tail": f"timeout {timeout_s}s{tail}"[-300:]}
+        return f"{label}: timeout {timeout_s}s{tail}", rung
+    sys.stderr.write(_clean_stderr(r.stderr))
     if r.returncode == 0:
         for line in reversed(r.stdout.strip().splitlines()):
             line = line.strip()
             if line.startswith("{"):
                 try:
-                    return json.loads(line)
+                    return (json.loads(line),
+                            {"rung": label, "rc": 0, "tail": ""})
                 except json.JSONDecodeError:
                     break
-    tail = (r.stderr or r.stdout).strip()[-300:].replace("\n", " | ")
-    return f"{label}: rc={r.returncode} {tail}"
+    tail = _clean_stderr((r.stderr or r.stdout).strip(), 300) \
+        .replace("\n", " | ")
+    rung = {"rung": label, "rc": r.returncode, "tail": tail}
+    return f"{label}: rc={r.returncode} {tail}", rung
+
+
+def _runner_record(rungs, probe_failed: bool) -> dict:
+    """The bounded, structured ``{rc, tail, probe_failed}`` runner block
+    every parent-side result embeds — the durable form of what the
+    scheduled driver's 2000-char output tail can only sample.
+    ``scripts/bench_history.py`` counts probe-failure streaks off it."""
+    failed = [r for r in rungs if r["rc"] not in (0,)]
+    tail = " ; ".join(f"{r['rung']}: rc={r['rc']} {r['tail']}".strip()
+                      for r in failed)
+    return {"rc": rungs[-1]["rc"] if rungs else None,
+            "tail": tail[-600:],
+            "probe_failed": bool(probe_failed)}
 
 
 def _tpu_reachable(timeout_s: int) -> bool:
@@ -839,7 +927,7 @@ def _tpu_reachable(timeout_s: int) -> bool:
                 return True
             sys.stderr.write(f"bench: tpu probe attempt {attempt + 1} failed "
                              f"(rc={r.returncode}): "
-                             f"{r.stderr.strip()[-300:]}\n")
+                             f"{_clean_stderr(r.stderr.strip(), 300)}\n")
         except subprocess.TimeoutExpired:
             sys.stderr.write(f"bench: tpu probe attempt {attempt + 1} timed "
                              f"out after {timeout_s}s\n")
@@ -880,14 +968,15 @@ def main():
         # the mesh rung is its own single-child mode (forced host mesh,
         # GSPMD-vs-shardmap A/B + compiled-HLO collective census) — the
         # supervisor contract (one JSON line, errors survivable) holds
-        res = _run_child("cpu", "mesh", timeout_s)
+        res, rung = _run_child("cpu", "mesh", timeout_s)
         if isinstance(res, dict):
             print(json.dumps(res))
         else:
             print(json.dumps({
                 "metric": "mesh GSPMD-vs-shardmap data-parallel training",
                 "value": 0.0, "unit": "trees/sec", "vs_baseline": None,
-                "degraded": f"mesh rung failed: {res}"}))
+                "degraded": f"mesh rung failed: {res}",
+                "runner": _runner_record([rung], False)}))
         return
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
     want = os.environ.get("BENCH_PLATFORM")  # force 'cpu' or 'tpu'
@@ -900,8 +989,11 @@ def main():
         # the capture playbook's forced-XLA A/B partner (bench_1m_xla):
         # drop the fused rung so the ladder lands on the einsum reference
         ladder = [r for r in ladder if r[1] != "fused"]
+    probe_failed = False
+    rungs: list = []
     if ladder[0][0] == "tpu" and not _tpu_reachable(probe_timeout):
         sys.stderr.write("bench: tpu unreachable, skipping tpu rungs\n")
+        probe_failed = True
         dropped = " ; ".join(f"{_rung_label(p, q)}: skipped, tpu "
                              "probe failed" for p, q in ladder if p == "tpu")
         ladder = [r for r in ladder if r[0] != "tpu"]
@@ -909,16 +1001,19 @@ def main():
             res = {
                 "metric": "higgs-like binary GBDT training throughput",
                 "value": 0.0, "unit": "trees/sec", "vs_baseline": 0.0,
-                "degraded": dropped}
+                "degraded": dropped, "probe_failed": True,
+                "runner": _runner_record([], True)}
             _attach_last_tpu_capture(res)
             print(json.dumps(res))
             return
         os.environ["BENCH_TPU_SKIPPED"] = dropped
     errors = []
     if os.environ.get("BENCH_TPU_SKIPPED"):
+        probe_failed = True
         errors.append(os.environ["BENCH_TPU_SKIPPED"])
     for i, (platform, mode) in enumerate(ladder):
-        res = _run_child(platform, mode, timeout_s)
+        res, rung = _run_child(platform, mode, timeout_s)
+        rungs.append(rung)
         if isinstance(res, dict):
             if errors:
                 # never clobber a child-reported degradation (e.g. the
@@ -929,6 +1024,9 @@ def main():
                                    + " ; ".join(errors)
                                    + (f" ; {prior}" if prior else ""))
                 _attach_last_tpu_capture(res)
+            if probe_failed:
+                res["probe_failed"] = True
+            res["runner"] = _runner_record(rungs, probe_failed)
             print(json.dumps(res))
             return
         errors.append(res)
@@ -940,7 +1038,10 @@ def main():
         "unit": "trees/sec",
         "vs_baseline": 0.0,
         "degraded": "all rungs failed: " + " ; ".join(errors),
+        "runner": _runner_record(rungs, probe_failed),
     }
+    if probe_failed:
+        res["probe_failed"] = True
     _attach_last_tpu_capture(res)
     print(json.dumps(res))
 

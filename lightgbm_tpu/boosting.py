@@ -31,6 +31,7 @@ from .data.dataset import TrainingData
 from .grower import FeatureMeta, GrowerConfig, make_grower
 from .metrics import Metric, create_metric, default_metric_for_objective
 from .obs import collectives as obs_collectives
+from .obs import devprof as obs_devprof
 from .obs import flight as obs_flight
 from .obs import memory as obs_memory
 from .obs import metrics as obs_metrics
@@ -938,8 +939,10 @@ class GBDT:
         (gbdt.cpp:465-581 TrainOneIter).  Each iteration is one telemetry
         span; the per-phase spans inside come from ``self.timers``."""
         fl = obs_flight.get_flight()
+        dp = obs_devprof.get_devprof()
         t0 = time.perf_counter() if fl.enabled else 0.0
-        with obs_trace.get_tracer().span("iteration", index=int(self.iter_)):
+        with obs_trace.get_tracer().span("iteration", index=int(self.iter_)), \
+                dp.iteration(int(self.iter_)):
             stop = self._train_one_iter_inner(grad, hess)
         # per-iteration device-memory gauge (no-op singleton when memory
         # observability is off; armed it is a host-side read — it rides
@@ -964,6 +967,12 @@ class GBDT:
             coll = obs_collectives.totals()
             if coll["calls"]:
                 rec["collective_bytes"] = coll["bytes"]
+            # the just-captured devprof window's idle-gap fraction rides
+            # the progress record (parsed before this record is built, so
+            # the supervisor's straggler verdict can cite it)
+            gap = dp.pop_idle_gap() if dp.enabled else None
+            if gap is not None:
+                rec["idle_gap_fraction"] = gap
             fl.progress(int(self.iter_), **rec)
         return stop
 

@@ -214,6 +214,24 @@ class Config:
                                    # (torn tails fall back to the previous
                                    # good snapshot; docs/ROBUSTNESS.md)
     profile_dir: str = ""          # write a jax.profiler trace of training here
+    device_profile: bool = False   # device-time attribution (obs/devprof.py,
+                                   # docs/OBSERVABILITY.md "Device-time
+                                   # attribution"): arm programmatic
+                                   # jax.profiler windows over profile_iters
+                                   # steady-state boosting iterations
+                                   # (first firing/compile excluded), parse
+                                   # the trace artifacts, and embed a
+                                   # schema-versioned device_profile block
+                                   # (per-phase device ms, top ops,
+                                   # host/device overlap + idle-gap per
+                                   # iteration) in the telemetry trace and
+                                   # bench JSON.  Implies telemetry=true;
+                                   # incompatible with profile_dir (both
+                                   # own the one jax profiler session)
+    profile_iters: int = 2         # steady-state iterations device_profile
+                                   # captures (>= 1); each window is one
+                                   # profiler start/stop around one
+                                   # boosting iteration
     trace_path: str = ""           # write a Chrome-trace span file (.json or
                                    # .jsonl) of training here (lightgbm_tpu.obs
                                    # telemetry; implies telemetry=true; render
@@ -704,6 +722,15 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.metrics_port < 0 or cfg.metrics_port > 65535:
         log.fatal("metrics_port must be in [0, 65535] (0 = off); got %d",
                   cfg.metrics_port)
+    if cfg.profile_iters < 1:
+        log.fatal("profile_iters must be >= 1 (steady-state iterations "
+                  "the device_profile plane captures); got %d",
+                  cfg.profile_iters)
+    if cfg.device_profile and cfg.profile_dir:
+        log.fatal("device_profile cannot be combined with profile_dir: "
+                  "both arm the one process-wide jax profiler session; "
+                  "use device_profile for attributed per-phase accounting "
+                  "or profile_dir for a raw whole-run XProf trace")
     if cfg.straggler_factor <= 1:
         log.fatal("straggler_factor must be > 1 (a rank is a straggler "
                   "when its progress rate falls that factor behind the "

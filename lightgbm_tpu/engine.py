@@ -54,11 +54,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # Chrome-trace span file; telemetry=true enables counters/spans without
     # a file.  The counter registry is reset per training so two runs in
     # one process never blur their kernel-identity evidence.
+    from .obs import devprof as obs_devprof
     from .obs import memory as obs_memory
     from .obs import trace as obs_trace
     from .obs.counters import counters as obs_counters
     trace_path = str(params.get("trace_path", "") or "")
-    telemetry_on = bool(trace_path) or str(
+    # device-time attribution (obs/devprof.py): implies telemetry — the
+    # attributor needs the TraceAnnotation phase windows the tracer mirrors
+    # into every profiler capture
+    devprof_on = str(params.get("device_profile", "")).strip().lower() \
+        in ("true", "1", "yes", "on", "+")
+    telemetry_on = bool(trace_path) or devprof_on or str(
         params.get("telemetry", "")).strip().lower() in ("true", "1", "yes",
                                                          "on", "+")
     if telemetry_on:
@@ -68,6 +74,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # per-phase samples are host-side reads (memory_stats on TPU, a
         # live-array census on CPU) — zero added device synchronizations
         obs_memory.start()
+    if devprof_on:
+        obs_devprof.start(
+            profile_iters=int(params.get("profile_iters", 2) or 2))
     # deterministic fault injection (utils/faults.py): a param-armed plan is
     # scoped to THIS training; an env-armed plan stays process-wide
     fault_spec = str(params.get("fault_inject", "") or "")
@@ -472,6 +481,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         raise
     finally:
         preempt_watch.restore()   # handlers are scoped to THIS training
+        if devprof_on:
+            # finalize BEFORE the trace writes: the device_profile block
+            # rides the trace as a telemetry.summary event so one file
+            # carries the host spans AND the device attribution
+            dp_summary = obs_devprof.stop()
+            if dp_summary is not None:
+                obs_trace.get_tracer().summary("device_profile", dp_summary)
         if telemetry_on:
             # recompile evidence: how many distinct (shape, donation)
             # entries the grower jit accumulated this training — a number

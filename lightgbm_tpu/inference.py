@@ -311,7 +311,11 @@ def _traverse(bins, cats, nanm, zerom, feat, thr, dl, miss, lc, rc, ic,
                          jnp.zeros((n,), jnp.int32)))
         return leaf
 
-    return jax.vmap(one_tree)(feat, thr, dl, miss, lc, rc, ic, cat_ref)
+    # named_scope twin of the host predict_traverse span: bakes the
+    # serving-traversal identity into the lowered HLO so the device-time
+    # attributor (obs/devprof.py) can account traversal kernels by scope
+    with jax.named_scope("traverse"):
+        return jax.vmap(one_tree)(feat, thr, dl, miss, lc, rc, ic, cat_ref)
 
 
 def _leaves_from_raw_impl(x, thr_table, *node_args):
@@ -375,7 +379,8 @@ def _traverse_packed(dat, w0s, w1s, depth):
                              (jnp.zeros((n,), jnp.int32),
                               jnp.zeros((n,), jnp.int32)))[1]
 
-    return jax.vmap(one_tree)(w0s, w1s)
+    with jax.named_scope("traverse"):   # devprof scope twin (see _traverse)
+        return jax.vmap(one_tree)(w0s, w1s)
 
 
 def _pack_data_words(bins, nanm, zerom):

@@ -230,6 +230,19 @@ def progress_rate(records: List[dict]) -> Optional[float]:
     return di / dt
 
 
+def recent_idle_gap(records: List[dict]) -> Optional[float]:
+    """Median ``idle_gap_fraction`` across the ``progress`` records of one
+    rank's stream window (present when the devprof plane was armed), or
+    None — a straggler verdict that can say "the gap is host-side idle,
+    not device work" is worth far more than a bare rate ratio."""
+    gaps = [float(r["idle_gap_fraction"]) for r in records
+            if r.get("event") == "progress"
+            and isinstance(r.get("idle_gap_fraction"), (int, float))]
+    if not gaps:
+        return None
+    return round(statistics.median(gaps), 4)
+
+
 def detect_stragglers(rates: Dict[int, Optional[float]],
                       factor: float) -> List[Dict[str, Any]]:
     """Ranks whose progress rate falls ``factor`` behind the group median

@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -116,6 +117,31 @@ def _collect_sources() -> List[Tuple[str, Dict[str, Any], float, str]]:
     return out
 
 
+# ------------------------------------------------------------ capture age
+
+# wall-clock of the newest on-chip evidence (a devprof capture window or
+# an explicitly noted profile/capture artifact); None = never this process
+_last_capture_ts: Optional[float] = None
+
+
+def note_capture(ts: Optional[float] = None) -> None:
+    """Record that fresh device-profile evidence was just captured
+    (called by :mod:`lightgbm_tpu.obs.devprof` per completed window)."""
+    global _last_capture_ts
+    _last_capture_ts = time.time() if ts is None else float(ts)
+
+
+def last_capture_age() -> float:
+    """Seconds since the newest capture, or -1 when none happened — the
+    ROADMAP capture-backlog early warning: a scrape answers "is the
+    on-chip evidence stale?" without reading artifacts."""
+    if _last_capture_ts is None:
+        return -1.0
+    # whole-second resolution: staleness is a minutes/hours question, and
+    # back-to-back scrapes (snapshot vs a live GET) must agree sample-wise
+    return float(int(max(0.0, time.time() - _last_capture_ts)))
+
+
 # ---------------------------------------------------------------- rendering
 
 
@@ -148,6 +174,7 @@ def _families() -> Dict[str, Tuple[str, Dict[str, float]]]:
         add(name, {}, v, "gauge")
     add("events_dropped", {}, snap["events_dropped"], "counter")
     add("process_index", {}, snap["process_index"], "gauge")
+    add("last_capture_age_seconds", {}, last_capture_age(), "gauge")
     for name, labels, value, mtype in _collect_sources():
         add(name, dict(labels or {}), value, mtype)
     return fams

@@ -22,8 +22,9 @@ from typing import Any, Dict, List, Optional
 
 # --json output schema: 2 added the schema stamp itself plus the per-file
 # serving_stats / hlo_collectives entries (the multi-rank merge parity of
-# the markdown report)
-REPORT_SCHEMA_VERSION = 2
+# the markdown report); 3 added the per-file device_profile entry (the
+# obs/devprof.py attribution block embedded as a telemetry.summary event)
+REPORT_SCHEMA_VERSION = 3
 
 
 def load_events(path: str) -> List[dict]:
@@ -247,6 +248,56 @@ def _serving_lines(events: List[dict],
     return lines
 
 
+def _devprof_lines(events: List[dict],
+                   rank: Optional[int] = None) -> List[str]:
+    """The report's Device time section: the ``device_profile`` summary
+    the devprof plane embeds (per-phase device ms, top ops, per-iteration
+    host/device overlap) — the on-device answer the host span tables
+    cannot give."""
+    dp = summary_payload(events, "device_profile")
+    if not dp:
+        return []
+    title = "## Device time (devprof attribution)" + \
+        (f" — rank {rank}" if rank is not None else "")
+    frac = dp.get("attributed_fraction")
+    lines = ["", title, "",
+             f"Captured {dp.get('captured_iterations', 0)} steady-state "
+             f"iteration window(s) (first firing/compile excluded); "
+             f"{dp.get('total_op_ms', 0):.1f} ms of device op time, "
+             + (f"{frac:.1%} attributed to named phases."
+                if isinstance(frac, (int, float))
+                else "nothing attributable recorded."), ""]
+    phases = dp.get("phase_device_ms", {})
+    total = dp.get("total_op_ms") or 0
+    if phases:
+        lines += _md_table(
+            ["phase", "device ms", "share"],
+            [[p, f"{ms:.3f}", f"{ms / total:.1%}" if total else "-"]
+             for p, ms in phases.items()])
+    top = dp.get("top_ops", [])
+    if top:
+        lines += ["", "Top ops by device time:", ""]
+        lines += _md_table(
+            ["op", "phase", "ms", "count"],
+            [[o.get("op"), o.get("phase"), f"{o.get('ms', 0):.3f}",
+              o.get("count")] for o in top])
+    iters = dp.get("iterations", [])
+    if iters:
+        lines += ["", "Per-iteration host↔device accounting (idle gap = "
+                      "host window not covered by device work):", ""]
+        lines += _md_table(
+            ["iteration", "host ms", "device busy ms", "overlap",
+             "idle gap"],
+            [[it.get("iteration"), f"{it.get('host_ms', 0):.3f}",
+              f"{it.get('device_busy_ms', 0):.3f}",
+              f"{it.get('overlap_fraction', 0):.1%}",
+              f"{it.get('idle_gap_fraction', 0):.1%}"] for it in iters])
+    if dp.get("capture_failed"):
+        lines += ["", "(capture failed mid-run — the table covers the "
+                      "windows that completed)"]
+    return lines
+
+
 def render(path) -> str:
     paths = [path] if isinstance(path, str) else list(path)
     ranked = load_events_ranked(paths)
@@ -369,6 +420,11 @@ def render(path) -> str:
                                     rsnap.get("gauges", {}), rank=rank)
     else:
         lines += _serving_lines(events, counters, snap.get("gauges", {}))
+    if multi:
+        for p, rank, evs in ranked:
+            lines += _devprof_lines(evs, rank=rank)
+    else:
+        lines += _devprof_lines(events)
     lines += _memory_lines(snap)
     events_list = snap.get("events", [])
     if events_list:
@@ -419,6 +475,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # parity): one serving process per trace file
                     "serving_stats": summary_payload(events,
                                                      "serving stats"),
+                    "device_profile": summary_payload(events,
+                                                      "device_profile"),
                     "hlo_collectives": summary.get("counters", {}).get(
                         "hlo_collective_calls", {}),
                     "events_dropped": summary.get("events_dropped", 0),

@@ -479,20 +479,29 @@ class Supervisor:
             return
         self._last_straggler_check = now
         rates = {}
+        tails = {}
         for r in range(self.world):
             recs = flight_mod.tail_records(
                 flight_mod.stream_path(self.obs_stream, r))
+            tails[r] = recs
             rates[r] = flight_mod.progress_rate(recs)
         for s in flight_mod.detect_stragglers(rates, self.straggler_factor):
             key = (s["rank"], self.attempt)
             if key in self._stragglers_flagged:
                 continue
             self._stragglers_flagged.add(key)
+            extra = {}
+            # devprof-armed ranks stamp the per-iteration idle-gap into
+            # their progress records; citing it distinguishes a
+            # host-stalled straggler from a device-bound one
+            gap = flight_mod.recent_idle_gap(tails.get(s["rank"], []))
+            if gap is not None:
+                extra["idle_gap_fraction"] = gap
             counters.event("rank_straggler", rank=s["rank"],
                            rate=s["rate"], median_rate=s["median_rate"],
                            behind=s["behind"],
                            factor=self.straggler_factor,
-                           attempt=self.attempt)
+                           attempt=self.attempt, **extra)
             counters.gauge(f"rank_straggler_behind_r{s['rank']}",
                            s["behind"])
             log.warning("Supervisor: rank %d is a straggler — %.3g it/s "

@@ -159,6 +159,27 @@ def metrics_row(d):
             f"(schema v{m.get('schema_version')})")
 
 
+def devprof_row(d):
+    """One-line device-time coverage summary of an artifact's
+    "device_profile" block (obs/devprof.py: programmatic profiler windows
+    attributed to the named_scope phase twins) — the row that explains
+    WHY a rung wins, not just that it does.  None when the artifact
+    predates the attribution plane."""
+    dp = d.get("device_profile")
+    if not isinstance(dp, dict):
+        return None
+    phases = dp.get("phase_device_ms") or {}
+    top = ", ".join(f"{p}={ms:g}ms" for p, ms in list(phases.items())[:3])
+    frac = dp.get("attributed_fraction")
+    gaps = [it.get("idle_gap_fraction") for it in dp.get("iterations", [])
+            if isinstance(it.get("idle_gap_fraction"), (int, float))]
+    gap_tag = f", idle gap ~{sum(gaps) / len(gaps):.0%}" if gaps else ""
+    return (f"devprof: {dp.get('captured_iterations')} window(s), "
+            f"{dp.get('total_op_ms')} ms device op time"
+            f"{f' ({frac:.0%} attributed)' if frac is not None else ''}"
+            f"{f': {top}' if top else ''}{gap_tag}")
+
+
 def observed_split_find(d):
     """Dominant split_find identity the child's telemetry traced
     (bench.py embeds the grower's split_find_dispatch counter)."""
@@ -278,6 +299,9 @@ def main():
     hx = metrics_row(head)
     if hx:
         print(f"{'':10}{hx}")
+    hd = devprof_row(head)
+    if hd:
+        print(f"{'':10}{hd}")
     if not deciding:
         print("headline is not a clean TPU number -> NO flip decisions "
               "from this capture; table below is informational only")
@@ -313,6 +337,9 @@ def main():
             xr = metrics_row(d)
             if xr:
                 print(f"{'':53}{xr}")
+            dr = devprof_row(d)
+            if dr:
+                print(f"{'':53}{dr}")
             for line in mesh_rows(d):
                 print(f"{'':53}{line}")
     for fname, knob, action, base_name in FLIPS:

@@ -2,6 +2,8 @@
 # Round-5 capture playbook, priority-ordered per the round-4 verdict:
 #   1. headline bench (the driver artifact has missed four rounds — bank it)
 #      + BENCH_TRACE telemetry trace per rung (docs/OBSERVABILITY.md)
+#      + BENCH_DEVICE_PROFILE devprof_*.json device-time attribution on
+#        the major rungs (headline / xla A/B / serving / full Higgs)
 #   2. microprobe (name the ~3.3 ms/split residual; VERDICT #2)
 #   3. ordered_bins+sort combined A/B (the two big structural flips at once)
 #   4. compact-partition A/B (lowering-proven offline; biggest partition win)
@@ -94,7 +96,11 @@ alive_or_abort() {
 }
 
 echo "== headline bench 1M (current defaults) ==" | tee -a "$OUT/log.txt"
+# BENCH_DEVICE_PROFILE: the devprof plane (obs/devprof.py) captures
+# profiler windows over dedicated steady iterations and banks the
+# attributed per-phase device-time block as devprof_*.json per major rung
 BENCH_TRACE="$OUT/trace_1m.jsonl" \
+BENCH_DEVICE_PROFILE=1 BENCH_DEVPROF="$OUT/devprof_1m.json" \
 BENCH_TREES=10 BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 python bench.py \
     > "$OUT/bench_1m.json" 2>> "$OUT/log.txt" \
     || fail_artifact "headline" $? "$OUT/bench_1m.json"
@@ -125,6 +131,19 @@ if [ -n "$PREV" ] && [ -f "$PREV/bench_1m.json" ]; then
     fi
     cat "$OUT/obs_diff_1m.txt" >> "$OUT/log.txt" || true
 fi
+# longitudinal verdict over the whole scheduled series + prior captures
+# (scripts/bench_history.py): probe-failure streaks, throughput drift,
+# kernel flips, memory creep — informational here, banked for CI
+if ls BENCH_r*.json > /dev/null 2>&1; then
+    if timeout 300 python scripts/bench_history.py BENCH_r*.json \
+            "$OUT/bench_1m.json" > "$OUT/bench_history.txt" 2>&1; then
+        echo "bench_history: series OK" | tee -a "$OUT/log.txt"
+    else
+        echo "bench_history: TREND FAILURE(S) (bench_history.txt)" \
+            | tee -a "$OUT/log.txt"
+    fi
+    cat "$OUT/bench_history.txt" >> "$OUT/log.txt" || true
+fi
 echo "jax_cache entries: $(ls .jax_cache 2>/dev/null | wc -l)" \
     | tee -a "$OUT/log.txt"   # nonzero growth => TPU executables persist
 snap "headline bench"
@@ -148,6 +167,7 @@ echo "== forced-XLA A/B (fused rung dropped; headline pairs with this) ==" \
 # rung for the direct A/B pair (decide_flips: pallas_fused auto->on if
 # fused wins >=5%)
 BENCH_TRACE="$OUT/trace_1m_xla.jsonl" \
+BENCH_DEVICE_PROFILE=1 BENCH_DEVPROF="$OUT/devprof_1m_xla.json" \
 BENCH_TREES=6 BENCH_FUSED=0 BENCH_STAGE_TIMEOUT=1200 timeout -k 30 1500 \
     python bench.py > "$OUT/bench_1m_xla.json" 2>> "$OUT/log.txt" \
     || fail_artifact "xla_ab" $? "$OUT/bench_1m_xla.json"
@@ -204,6 +224,7 @@ echo "== serving rung (SoA microbatch engine: latency/QPS + recompile pin) ==" \
 # (predict_jit_entries) — this window prices on-chip serving next to
 # training for the first time
 BENCH_TRACE="$OUT/trace_serving.jsonl" \
+BENCH_DEVICE_PROFILE=1 BENCH_DEVPROF="$OUT/devprof_serving.json" \
 BENCH_SERVING=1 BENCH_TREES=6 BENCH_STAGE_TIMEOUT=1500 timeout 1800 \
     python bench.py > "$OUT/bench_serving.json" 2>> "$OUT/log.txt" \
     || fail_artifact "serving" $? "$OUT/bench_serving.json"
@@ -289,6 +310,7 @@ snap "63-bin bench"
 alive_or_abort "63-bin"
 echo "== FULL Higgs 10.5M x 28 (north-star shape) ==" | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_higgs_full.jsonl" \
+BENCH_DEVICE_PROFILE=1 BENCH_DEVPROF="$OUT/devprof_higgs_full.json" \
 BENCH_ROWS=10500000 BENCH_TREES=3 BENCH_STAGE_TIMEOUT=2400 \
     timeout -k 30 2700 python bench.py \
     > "$OUT/bench_higgs_full.json" 2>> "$OUT/log.txt" \

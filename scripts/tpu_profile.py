@@ -3,7 +3,9 @@
 Produces the evidence behind docs/PERF.md: per-phase timing of the bench
 workload, a tile-size sweep for the Pallas histogram kernel (the analogue of
 the reference's GPU workgroup tuning, gpu_tree_learner.cpp:103-121), and an
-optional jax.profiler trace.
+optional device-time attribution capture (obs/devprof.py — one capture
+path for the whole repo; the raw profiler artifacts land in trace_dir and
+the attributed per-phase summary in trace_dir/devprof.json).
 
     python scripts/tpu_profile.py [rows] [trace_dir]
 """
@@ -118,9 +120,32 @@ def main():
               flush=True)
 
     if trace_dir:
-        with jax.profiler.trace(trace_dir):
+        # the devprof plane owns profiler start/stop now (one capture path
+        # with bench.py / engine.train): armed before a short training, it
+        # skips the compile firing, captures per-iteration windows into
+        # trace_dir, and attributes device op time to the named_scope
+        # phase twins.  Telemetry spans must be live for the host phase
+        # windows to reach the capture.
+        import json as _json
+        from lightgbm_tpu.obs import devprof as obs_devprof
+        from lightgbm_tpu.obs import trace as obs_trace
+        obs_trace.start(None)
+        obs_devprof.start(log_dir=trace_dir, profile_iters=2,
+                          keep_artifacts=True)
+        try:
             tps_i, _, _ = train_tps(X, y, n_timed=2)
-        print("trace written to", trace_dir)
+        finally:
+            summary = obs_devprof.stop()
+            obs_trace.stop()
+        if summary is not None:
+            out = os.path.join(trace_dir, "devprof.json")
+            with open(out, "w") as f:
+                _json.dump(summary, f, indent=1)
+            print("device-time attribution:",
+                  _json.dumps({k: summary[k] for k in
+                               ("captured_iterations", "attributed_fraction",
+                                "phase_device_ms")}))
+            print("trace written to", trace_dir, "— summary", out)
 
 
 if __name__ == "__main__":
