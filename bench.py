@@ -668,6 +668,12 @@ def child_main():
     # device-time attribution block, finalized BEFORE the micro-rungs so
     # it describes the measured training only (obs/devprof.py)
     device_profile = obs_devprof.stop() if devprof_armed else None
+    if devprof_armed and not bench_trace:
+        # the tracer was armed only to mirror phase windows into the
+        # devprof captures — stop it here so its span overhead never rides
+        # the leaves-sweep / serving micro-rung numbers below (no path set,
+        # so stop() writes nothing and returns None)
+        obs_trace.stop()
     if device_profile is not None:
         sys.stderr.write(
             f"bench: devprof captured={device_profile['captured_iterations']}"

@@ -218,13 +218,20 @@ def _busy_us(ops: List[dict], t0: Optional[float] = None,
     return busy
 
 
-def attribute(events: List[dict], top_k: int = TOP_K) -> Dict[str, Any]:
+def attribute(events: List[dict], top_k: int = TOP_K,
+              ops: Optional[List[dict]] = None) -> Dict[str, Any]:
     """Attribute device op time to named phases.
 
     Scope-token attribution first (named_scope twins in op names/metadata),
     host-window fallback second.  Returns the per-phase device-ms table,
-    the top-K op list, totals, and the attributed fraction."""
-    ops = op_events(events)
+    the top-K op list, totals, and the attributed fraction.
+
+    ``ops`` bypasses :func:`op_events` with already-classified op events —
+    required when ``events`` no longer carries the ``process_name``
+    metadata that identifies device pids (the armed profiler's retained
+    state)."""
+    if ops is None:
+        ops = op_events(events)
     wins = phase_windows(events)
     phase_us: Dict[str, float] = {}
     per_op: Dict[Tuple[str, str], Dict[str, float]] = {}
@@ -328,7 +335,11 @@ class DeviceProfiler:
         self._cur_dir = ""
         self._last_gap: Optional[float] = None
         self.iterations: List[Dict[str, Any]] = []
-        self._events: List[dict] = []   # accumulated op+window events
+        # classified per-window, kept separately: device-pid ops are only
+        # identifiable while the process_name metadata is at hand, so
+        # summary() must never re-run op_events() over retained state
+        self._ops: List[dict] = []          # op events, all windows
+        self._host_events: List[dict] = []  # host phase-window events
 
     # ----------------------------------------------------- window control
 
@@ -372,12 +383,15 @@ class DeviceProfiler:
                 log.warning("devprof: unreadable artifact %s: %s", path, exc)
         ops = op_events(events)
         busy_us = _busy_us(ops)
+        # host_ms spans start_trace-return to stop_trace-call, so any
+        # profiler-induced host overhead inside the window counts as idle
+        # gap — on short iterations idle_gap_fraction is biased high
         host_us = host_s * 1e6
         overlap = min(1.0, busy_us / host_us) if host_us > 0 else 0.0
         gap = round(max(0.0, 1.0 - overlap), 4)
         self._last_gap = gap
-        self._events.extend(ops)
-        self._events.extend(
+        self._ops.extend(ops)
+        self._host_events.extend(
             ev for ev in events
             if ev.get("ph") == "X" and str(ev.get("name")) in HOST_PHASES)
         self.iterations.append({
@@ -415,7 +429,8 @@ class DeviceProfiler:
         }
         if self._failed:
             block["capture_failed"] = True
-        block.update(attribute(self._events, top_k=self.top_k))
+        block.update(attribute(self._host_events, top_k=self.top_k,
+                               ops=self._ops))
         return block
 
     def finalize(self) -> Optional[Dict[str, Any]]:

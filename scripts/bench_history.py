@@ -170,10 +170,15 @@ def _streaks(entries, key):
 
 
 def _groups(entries):
-    """Measured entries grouped by metric identity, series order kept."""
+    """Measured entries grouped by metric identity, series order kept.
+
+    A parsed value stays in the series even when the driver recorded a
+    nonzero rc (``run_failed``) — the measurement happened; dropping it
+    would silently thin the drift/flip/creep evidence.  The odd exit is
+    still counted by the run_failure_streak verdict."""
     groups = {}
     for e in entries:
-        if e["run_failed"] or e["value"] is None or e["value"] <= 0:
+        if e["value"] is None or e["value"] <= 0:
             continue
         groups.setdefault(e["metric"] or "?", []).append(e)
     return groups
@@ -192,8 +197,9 @@ def verdicts(entries, drift_pct=15.0, memory_pct=25.0, streak_min=2):
         if len(run) >= streak_min:
             findings.append(_finding(
                 "run_failure_streak", WARN,
-                f"{len(run)} consecutive round(s) produced no parsed "
-                f"result ({run[0]}..{run[-1]})", rounds=run))
+                f"{len(run)} consecutive round(s) exited nonzero or "
+                f"produced no parsed result ({run[0]}..{run[-1]})",
+                rounds=run))
     for metric, group in _groups(entries).items():
         if len(group) >= 3:
             *prev, last = group

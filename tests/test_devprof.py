@@ -213,6 +213,40 @@ def test_device_profile_rejects_profile_dir_combo(tmp_path):
                       "profile_dir": str(tmp_path / "prof")})
 
 
+def test_summary_keeps_device_pid_ops_across_windows(tmp_path, monkeypatch):
+    """Regression: summary() re-attributes over the profiler's RETAINED
+    state, which no longer carries the process_name metadata that
+    identifies device pids — the classified ops must be stored as ops, not
+    re-filtered, or TPU-style captures (device-pid events without hlo_op
+    args) come back empty on the second pass."""
+    import jax
+    dp = obs_devprof.DeviceProfiler(log_dir=str(tmp_path), profile_iters=1,
+                                    keep_artifacts=True)
+
+    def fake_start(d):
+        os.makedirs(d, exist_ok=True)
+
+    def fake_stop():
+        with open(os.path.join(dp._cur_dir, "host.trace.json"), "w") as f:
+            json.dump({"traceEvents": _tpu_fixture()}, f)
+
+    monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+    with dp.iteration(0):       # compile firing — never captured
+        pass
+    with dp.iteration(1):       # captured steady-state window
+        pass
+    s = dp.summary()
+    assert s["captured_iterations"] == 1
+    assert s["op_count"] == 4                      # device-pid ops survive
+    assert s["total_op_ms"] == pytest.approx(1.1)
+    assert s["phase_device_ms"]["histogram"] == pytest.approx(0.6)
+    assert s["phase_device_ms"]["split_find"] == pytest.approx(0.3)
+    assert s["device_busy_ms"] == pytest.approx(1.1)
+    # the per-iteration accounting agrees with the summary's device view
+    assert s["iterations"][0]["device_busy_ms"] == pytest.approx(1.1)
+
+
 def test_armed_cpu_capture_attributes_device_time():
     """Acceptance pin: an armed CPU training captures steady-state windows
     (the compile firing excluded) and attributes >= 90% of captured op
@@ -383,6 +417,23 @@ def test_bench_history_probe_streak_first_class_field(tmp_path, capsys):
     streaks = [x for x in out["findings"]
                if x["check"] == "probe_failure_streak"]
     assert streaks and streaks[0]["rounds"] == ["r01", "r02"]
+
+
+def test_bench_history_nonzero_rc_keeps_parsed_values(tmp_path, capsys):
+    """A driver record whose bench emitted a valid result line but exited
+    nonzero still feeds the drift series — the measurement happened; only
+    the run_failure_streak counts the odd exit."""
+    bh = _load_script("bench_history")
+    docs = [_series_doc(v) for v in (1.20, 1.21, 1.19)]
+    # last round: parsed result present, driver rc nonzero -> the 0.80
+    # value must still trigger the drift FAIL instead of vanishing
+    docs.append({"cmd": "bench.py", "rc": 1, "tail": "late crash",
+                 "parsed": _series_doc(0.80)})
+    rc = bh.main(_write_series(tmp_path, docs) + ["--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(x["check"] == "throughput_drift" and x["severity"] == "fail"
+               for x in out["findings"])
 
 
 def test_bench_history_kernel_identity_flip_fails(tmp_path, capsys):
