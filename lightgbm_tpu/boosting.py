@@ -431,15 +431,22 @@ class GBDT:
         # Every downgrade from an explicit request is loud (the rung-
         # honesty discipline: labels must name what runs).
         impl = cfg.parallel_impl
-        if impl == "gspmd" and process_count() > 1:
-            log.warning("parallel_impl=gspmd is unavailable across "
-                        "processes for now; falling back to the shard_map "
-                        "learners (the multi-host axis keeps the proven "
-                        "path until on-chip numbers land)")
+        if impl == "gspmd" and process_count() > 1 \
+                and cfg.tree_learner == "feature":
+            # feature-parallel multi-host replicates the FULL dataset on
+            # every process (the reference contract); the multi-process
+            # gspmd placement assembles per-process ROW partitions — the
+            # two data contracts are incompatible, so the replication
+            # layout keeps the shard_map learner
+            log.warning("parallel_impl=gspmd is unavailable for "
+                        "multi-process tree_learner=feature (the "
+                        "full-data-everywhere replication contract); "
+                        "falling back to shard_map")
             obs_counters.event(
                 "layout_downgrade", stage="boosting",
                 requested="parallel_impl=gspmd", resolved="shardmap",
-                reason="multi-process training")
+                reason="multi-process feature-parallel replicates the "
+                       "full dataset")
             impl = "shardmap"
         if impl == "gspmd" and cfg.tree_learner == "voting":
             log.warning("parallel_impl=gspmd is unavailable for "
@@ -452,8 +459,14 @@ class GBDT:
                 reason="voting learner needs explicit vote collectives")
             impl = "shardmap"
         if impl == "auto":
-            impl = ("shardmap" if (process_count() > 1
-                                   or cfg.tree_learner == "voting")
+            # gspmd is the default single- AND multi-process: the compiler
+            # owns the data plane either way, and the elastic stack
+            # (supervisor shrink -> plan_mesh -> elastic_resume) composes
+            # with both.  Only the layouts whose data contracts gspmd
+            # cannot express keep the shard_map learners.
+            impl = ("shardmap" if (cfg.tree_learner == "voting"
+                                   or (process_count() > 1
+                                       and cfg.tree_learner == "feature"))
                     else "gspmd")
         self._parallel_impl = impl if use_dist else "serial"
         # nibble-pack <=16-bin column pairs for the histogram path
@@ -678,6 +691,16 @@ class GBDT:
         # serial TPU/CPU ladder baked into grower_cfg.hist_method does
         # not apply here — the partitioner owns the layout.
         gspmd_hist = "flat" if cfg.gspmd_hist == "auto" else cfg.gspmd_hist
+        procs = jax.process_count()
+        if gspmd_hist == "fused" and procs > 1:
+            log.warning("gspmd_hist=fused is single-process for now (the "
+                        "hybrid's shard_map island has no multi-host "
+                        "numbers); using the flat scatter-add histogram")
+            obs_counters.event(
+                "layout_downgrade", stage="boosting",
+                requested="gspmd_hist=fused", resolved="flat",
+                reason="multi-process training")
+            gspmd_hist = "flat"
         hist_width = (max(256, self.grower_cfg.max_bin)
                       if self._pack_plan is not None
                       else self.grower_cfg.max_bin)
@@ -702,20 +725,53 @@ class GBDT:
                     reason=reason)
                 gspmd_hist = "flat"
         nd = min(cfg.mesh_devices or n_devices, n_devices)
+        local_devs = jax.local_device_count()
+        if procs > 1 and nd != n_devices:
+            # a partial mesh cannot hold every process's row partition:
+            # some rank's devices would sit outside the mesh and its data
+            # would have nowhere to live
+            log.warning("mesh_devices=%d ignored across %d processes; the "
+                        "gspmd mesh must span all %d devices",
+                        cfg.mesh_devices, procs, n_devices)
+            obs_counters.event(
+                "layout_downgrade", stage="boosting",
+                requested=f"mesh_devices={cfg.mesh_devices}",
+                resolved=f"mesh_devices={n_devices}",
+                reason="multi-process gspmd mesh must span all devices")
+            nd = n_devices
         prefer = {"data": "data", "feature": "feature",
                   "data_feature": "square"}.get(cfg.tree_learner, "data")
         explicit = mesh_mod.parse_mesh_shape(cfg.mesh_shape, nd, prefer)
+        if explicit is not None and procs > 1:
+            refusal = mesh_mod.mesh_shape_fits_processes(
+                explicit[0], explicit[1], procs, local_devs)
+            if refusal is not None:
+                raise mesh_mod.MeshPlanError(
+                    f"mesh_shape={cfg.mesh_shape} cannot serve "
+                    f"{procs}-process training: {refusal}")
         ncols = int(np.shape(self.bins)[1])
+        n = self.num_data
+        rows_global = n
+        valid_rows = sum(vs.data.num_data for vs in self.valid_sets)
+        if procs > 1:
+            # the planner (and predict_hbm behind it) must see the GLOBAL
+            # shape: every process contributes its own row partition
+            from jax.experimental import multihost_utils
+            counts = np.asarray(multihost_utils.process_allgather(
+                np.asarray([n, valid_rows]))).reshape(-1, 2)
+            rows_global = int(counts[:, 0].sum())
+            valid_rows = int(counts[:, 1].sum())
+            self._proc_row_counts = counts[:, 0].astype(np.int64)
         capacity = (int(cfg.hbm_budget) if cfg.hbm_budget > 0
                     else obs_memory.device_capacity())
         plan_kwargs = dict(
-            rows=self.num_data, features=ncols,
+            rows=rows_global, features=ncols,
             bins=self.grower_cfg.max_bin,
             leaves=self.grower_cfg.num_leaves, num_class=self.num_class,
             bin_bytes=int(np.asarray(self.bins).dtype.itemsize),
             packed_cols=(self._pack_plan.num_storage_cols
                          if self._pack_plan is not None else 0),
-            valid_rows=sum(vs.data.num_data for vs in self.valid_sets),
+            valid_rows=valid_rows,
             gspmd_fused=(gspmd_hist == "fused"))
         if explicit is not None:
             d, f = explicit
@@ -734,7 +790,9 @@ class GBDT:
             # MeshPlanError propagates: the structured pre-flight error
             # (nothing fits) must surface before anything compiles
             plan = mesh_mod.plan_mesh(nd, capacity=capacity,
-                                      prefer=prefer, **plan_kwargs)
+                                      prefer=prefer, procs=procs,
+                                      local_devices=local_devs,
+                                      **plan_kwargs)
         sa = str(cfg.shard_axes).strip().lower().replace(" ", "")
         if sa == "batch":
             plan = plan._replace(block_shard_bins=False)
@@ -773,15 +831,43 @@ class GBDT:
             capacity_bytes=plan.capacity, reason=plan.reason)
         obs_counters.gauge("mesh_feature_shards", plan.feature)
         mesh = mesh_mod.make_named_mesh(plan.data, plan.feature)
-        n = self.num_data
-        self._row_pad = mesh_mod.pad_rows(n, plan.data)
-        binned = np.asarray(self.bins)
-        if self._row_pad:
-            binned = np.pad(binned, ((0, self._row_pad), (0, 0)))
         bins_spec = P(mesh_mod.BATCH_AXIS,
                       mesh_mod.FEATURE_AXIS if plan.block_shard_bins
                       else None)
-        self.bins = jax.device_put(binned, NamedSharding(mesh, bins_spec))
+        if procs > 1:
+            # each process holds its OWN row partition (the reference's
+            # pre-partitioned parallel learning): its rows go onto its
+            # own batch-axis block of the global NamedSharding array.
+            # Per-SHARD row count must agree globally (static shapes), so
+            # every partition pads to the global max.
+            shards_per_proc = plan.data // procs    # planner guarantees >=1
+            per_shard = int(-(-int(self._proc_row_counts.max())
+                              // shards_per_proc))
+            self._row_pad = per_shard * shards_per_proc - n
+            self._global_rows = per_shard * plan.data
+            binned = np.asarray(self.bins)
+            if self._row_pad:
+                binned = np.pad(binned, ((0, self._row_pad), (0, 0)))
+            self._multiproc = True
+            self._multiproc_replicated = False
+            self.bins = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, bins_spec), binned,
+                (self._global_rows, ncols))
+            # replicated grower inputs go in as host arrays (jit
+            # replicates them); device-committed single-process arrays
+            # would be rejected — the shard_map multiproc precedent
+            self.meta = FeatureMeta(*[None if f is None else np.asarray(f)
+                                      for f in self.meta])
+            log.info("Multi-process GSPMD: %d processes, %d local rows, "
+                     "%d global (padded) rows", procs, n,
+                     self._global_rows)
+        else:
+            self._row_pad = mesh_mod.pad_rows(n, plan.data)
+            binned = np.asarray(self.bins)
+            if self._row_pad:
+                binned = np.pad(binned, ((0, self._row_pad), (0, 0)))
+            self.bins = jax.device_put(binned,
+                                       NamedSharding(mesh, bins_spec))
         if self._hist_bins is not None:
             hb = np.asarray(self._hist_bins)
             if self._row_pad:
@@ -792,6 +878,8 @@ class GBDT:
         self._gspmd_plan = plan
         self._gspmd_row_sharding = NamedSharding(
             mesh, P(mesh_mod.BATCH_AXIS))
+        if self._multiproc:
+            self._row_sharding = self._gspmd_row_sharding
         log.info("Using GSPMD %s learner over a %dx%d (batch, feature) "
                  "mesh (%s)", cfg.tree_learner, plan.data, plan.feature,
                  plan.reason)
@@ -1209,7 +1297,16 @@ class GBDT:
             return jnp.asarray(np.asarray(row_leaf)[:self.num_data])
         parts = sorted(row_leaf.addressable_shards,
                        key=lambda s: s.index[0].start or 0)
-        local = np.concatenate([np.asarray(p.data) for p in parts])
+        # a (batch, feature) mesh replicates the row map along feature:
+        # keep one shard per row window, not one per device
+        seen = set()
+        uniq = []
+        for p in parts:
+            st = p.index[0].start or 0
+            if st not in seen:
+                seen.add(st)
+                uniq.append(p)
+        local = np.concatenate([np.asarray(p.data) for p in uniq])
         return jnp.asarray(local[:self.num_data])
 
     def _shrinkage_rate(self) -> float:

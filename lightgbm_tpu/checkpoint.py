@@ -97,6 +97,35 @@ def group_epoch() -> int:
         return 0
 
 
+def group_epoch_path(output_model: str) -> str:
+    """The on-disk fence for the jax.distributed startup barrier: the
+    supervisor writes the current incarnation epoch here before each
+    (re)launch, so a stale worker from a dead incarnation refuses the
+    rendezvous (``StaleEpochError``) instead of wedging the new group's
+    coordination service."""
+    return output_model + ".group_epoch"
+
+
+def write_group_epoch_file(output_model: str, epoch: int) -> None:
+    """Atomically stamp the group's current incarnation epoch (supervisor
+    side, before spawning workers)."""
+    path = group_epoch_path(output_model)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{int(epoch)}\n")
+    os.replace(tmp, path)
+
+
+def read_group_epoch_file(output_model: str) -> Optional[int]:
+    """The stamped group epoch, or None when no supervisor stamped one
+    (unsupervised runs have no fence to check)."""
+    try:
+        with open(group_epoch_path(output_model)) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return None
+
+
 class CheckpointError(RuntimeError):
     """The file is not a valid checkpoint (torn tail, bad CRC, bad blob)."""
 
@@ -796,6 +825,11 @@ def write_group_snapshot(output_model: str, iteration: int, model_str: str,
             % (1 << 64))
         manifest["num_features"] = int(metas[0].get("num_features", 0))
         manifest["num_class"] = int(metas[0].get("num_class", 1))
+        # model-shape knobs the supervisor's W-1 mesh pre-flight needs:
+        # plan_mesh judges histogram-pool bytes from leaves x bins, so a
+        # shrink decision made from the manifest alone must see them
+        manifest["num_leaves"] = int(metas[0].get("num_leaves", 31) or 31)
+        manifest["max_bin"] = int(metas[0].get("max_bin", 255) or 255)
     mdata = encode("", manifest)
     mpath = manifest_path(output_model, iteration)
     if fi.enabled and fi.fire("torn_manifest", iteration):

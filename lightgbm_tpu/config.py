@@ -404,8 +404,10 @@ class Config:
                                    # ``shardmap`` is the historical explicit
                                    # psum/all_gather choreography, kept as
                                    # the forced A/B partner.  ``auto``
-                                   # resolves gspmd single-process and
-                                   # shardmap across machines / for voting
+                                   # resolves gspmd single- AND multi-
+                                   # process; shardmap only for voting and
+                                   # multi-process feature-parallel (whose
+                                   # data contracts gspmd cannot express)
     mesh_shape: str = "auto"       # GSPMD (batch, feature) mesh extents:
                                    # auto (the memory-driven planner,
                                    # parallel/mesh.plan_mesh, sizes the mesh

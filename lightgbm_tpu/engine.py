@@ -246,6 +246,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     np.asarray(ts.binned), n_local, off),
                 "num_features": int(np.asarray(ts.binned).shape[1]),
                 "num_class": int(booster.inner.num_class),
+                # model-shape knobs for the supervisor's W-1 mesh
+                # pre-flight (plan_mesh sizes the histogram pool from
+                # leaves x bins)
+                "num_leaves": int(booster.inner.config.num_leaves),
+                "max_bin": int(booster.inner.config.max_bin),
             }
         return _elastic_cache[0]
 

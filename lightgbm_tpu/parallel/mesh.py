@@ -39,6 +39,8 @@ def distributed_is_initialized() -> bool:
         from jax._src import distributed as _dist
         return getattr(_dist.global_state, "client", None) is not None
     except Exception:       # pragma: no cover - future-jax defensive
+        from ..obs.counters import counters
+        counters.inc("distributed_probe_fallback")
         return False
 
 
@@ -65,8 +67,17 @@ def make_named_mesh(data: int, feature: int,
     """``(batch, feature)`` named mesh for the GSPMD learners
     (``parallel/gspmd.py``): rows shard over ``batch``, the histogram
     pool over ``feature``.  Either extent may be 1 (pure data- or pure
-    feature-sharding); the product must not exceed the device count."""
+    feature-sharding); the product must not exceed the device count.
+
+    Spans ALL processes' devices in process-major order: each process's
+    local devices occupy a contiguous block of batch-axis rows, so one
+    rank's row partition lands exactly on its own devices
+    (``jax.make_array_from_process_local_data`` in
+    ``boosting._setup_gspmd``) and the elastic shrink path re-cuts the
+    same global row order at any world size."""
     devs = list(devices) if devices is not None else jax.devices()
+    devs.sort(key=lambda d: (int(getattr(d, "process_index", 0)),
+                             int(getattr(d, "id", 0))))
     need = data * feature
     if need > len(devs):
         raise MeshPlanError(
@@ -105,11 +116,34 @@ def _mesh_factorizations(n: int):
     return out
 
 
+def mesh_shape_fits_processes(data: int, feature: int, procs: int,
+                              local_devices: int) -> Optional[str]:
+    """Can a ``(data, feature)`` mesh be laid out so every process's
+    local devices tile whole batch-axis rows?  Returns None when it can,
+    else the human-readable refusal.  Required for multi-process GSPMD:
+    each rank holds its OWN row partition, so its devices must cover a
+    contiguous block of batch rows across the FULL feature extent —
+    ``data`` a multiple of the process count and the per-process device
+    count a multiple of ``feature``."""
+    procs = max(1, int(procs))
+    if procs == 1:
+        return None
+    if data % procs != 0:
+        return (f"batch extent {data} does not divide over {procs} "
+                "processes (each rank's row partition needs whole "
+                "batch-axis rows)")
+    if local_devices and local_devices % feature != 0:
+        return (f"{local_devices} local device(s) per process cannot "
+                f"tile {feature} feature shard(s) per batch row")
+    return None
+
+
 def plan_mesh(n_devices: int, rows: int, features: int, bins: int = 255,
               leaves: int = 31, num_class: int = 1,
               bin_bytes: Optional[int] = None, packed_cols: int = 0,
               valid_rows: int = 0, capacity: Optional[int] = None,
-              prefer: str = "data", gspmd_fused: bool = False) -> MeshPlan:
+              prefer: str = "data", gspmd_fused: bool = False,
+              procs: int = 1, local_devices: int = 0) -> MeshPlan:
     """The memory-driven sharding planner (``mesh_shape=auto``).
 
     Evaluates ``obs/memory.predict_hbm`` per candidate ``(data,
@@ -126,12 +160,30 @@ def plan_mesh(n_devices: int, rows: int, features: int, bins: int = 255,
     replication alone does not fit.  With no capacity signal (CPU hosts
     report none) the preferred shape wins outright.
 
+    Multi-process jobs (``procs`` > 1, ``local_devices`` per process):
+    candidates that cannot map each process's row partition onto its own
+    devices are skipped (:func:`mesh_shape_fits_processes`) — a
+    feature-heavy shape a single process could serve may be
+    unreachable for a partitioned group, and the planner must say so
+    at pre-flight rather than let the array placement fail mid-setup.
+
     Raises :class:`MeshPlanError` when nothing fits — a structured
     pre-flight error in milliseconds instead of an on-chip OOM minutes
     into a capture window."""
     from ..obs.memory import predict_hbm
     n_devices = max(int(n_devices), 1)
     cands = _mesh_factorizations(n_devices)
+    if procs > 1:
+        fits = [(d, f) for d, f in cands
+                if mesh_shape_fits_processes(d, f, procs,
+                                             local_devices) is None]
+        if not fits:
+            raise MeshPlanError(
+                f"no factorization of {n_devices} device(s) lays out over "
+                f"{procs} processes x {local_devices or '?'} local "
+                "device(s): every candidate leaves some rank's row "
+                "partition straddling another process's devices")
+        cands = fits
     if prefer == "feature":
         cands = cands[::-1]
     elif prefer == "square":
@@ -222,16 +274,63 @@ def _enable_cpu_collectives() -> None:
         pass
 
 
+# epoch the runtime was last initialized under (the incarnation fence,
+# parallel/sync.py): a relaunched in-process training at a NEWER epoch
+# tears the stale runtime down and re-initializes instead of rejoining a
+# rendezvous its peers already abandoned
+_init_epoch: Optional[int] = None
+
+
+def shutdown_distributed() -> None:
+    """Tear the distributed runtime down (idempotent).  The supervisor
+    relaunch path spawns fresh processes — their runtimes die with them —
+    but an in-process relaunch (tests, embedding hosts) must disconnect
+    the dead incarnation's coordination client before the new epoch's
+    barrier can form."""
+    global _init_epoch
+    if distributed_is_initialized():
+        jax.distributed.shutdown()
+    _init_epoch = None
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     timeout: Optional[float] = None) -> None:
     """Multi-host bring-up (Network::Init analogue; machine-list file →
-    coordinator address)."""
-    if coordinator_address is not None:
-        _enable_cpu_collectives()
+    coordinator address).  The startup barrier is bounded: a dead peer
+    (or a stale survivor holding the old port) surfaces as a catchable
+    :class:`~..parallel.sync.CollectiveError` after ``timeout`` seconds
+    — with a structured ``distributed_init_failed`` event — never as an
+    indefinite hang the supervisor can only SIGKILL."""
+    global _init_epoch
+    if coordinator_address is None:
+        return
+    _enable_cpu_collectives()
+    kwargs = {}
+    if timeout and timeout > 0:
+        kwargs["initialization_timeout"] = max(1, int(timeout))
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **kwargs)
+    except TypeError:       # older jax: no initialization_timeout kwarg
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
+    except RuntimeError as e:
+        from ..obs.counters import counters
+        from .sync import CollectiveError
+        counters.event("distributed_init_failed",
+                       coordinator=coordinator_address,
+                       num_processes=num_processes, process_id=process_id,
+                       timeout=timeout, error=str(e))
+        raise CollectiveError(
+            f"distributed startup barrier failed for process "
+            f"{process_id}/{num_processes} (coordinator "
+            f"{coordinator_address}, timeout {timeout}s): {e}") from e
+    from ..checkpoint import group_epoch
+    _init_epoch = group_epoch()
 
 
 def parse_machine_list(path: str):
@@ -305,14 +404,43 @@ def init_distributed_from_config(cfg) -> bool:
     Machine 0 is the coordinator; its listed port doubles as the JAX
     coordination-service port.  Rank comes from ``LGBM_TPU_RANK`` or from
     matching local addresses against the list.  Returns True when running
-    multi-process (freshly initialized or already up)."""
+    multi-process (freshly initialized or already up).
+
+    Epoch fence at the startup barrier: when a supervisor stamped the
+    group's current incarnation into the epoch file
+    (``checkpoint.group_epoch_path``), a worker launched under an OLDER
+    epoch raises :class:`~.sync.StaleEpochError` before touching the
+    rendezvous — the startup-barrier extension of the per-payload fence
+    in parallel/sync.py.  A runtime initialized under a PREVIOUS epoch
+    (in-process relaunch) is torn down and re-initialized rather than
+    rejoined."""
     from ..utils import log
+    from ..checkpoint import group_epoch, read_group_epoch_file
     if getattr(cfg, "num_machines", 1) <= 1:
         return False
+    my_epoch = group_epoch()
+    stamped = read_group_epoch_file(getattr(cfg, "output_model", "") or "")
+    if stamped is not None and stamped > my_epoch:
+        from ..obs.counters import counters
+        from .sync import StaleEpochError
+        counters.event("stale_epoch_rejected", op="distributed_init",
+                       frame_epoch=my_epoch, group_epoch=stamped)
+        raise StaleEpochError(
+            f"startup barrier refused: this process was launched under "
+            f"epoch {my_epoch} but the group is at epoch {stamped} — a "
+            f"stale incarnation must not join the new rendezvous",
+            frame_epoch=my_epoch, group_epoch=stamped)
     # must not touch the backend (jax.devices/process_count) before
     # jax.distributed.initialize; use is_initialized to test idempotently
     if distributed_is_initialized():
-        return True                      # already initialized
+        if _init_epoch is not None and _init_epoch != my_epoch:
+            # in-process relaunch under a new incarnation: the old
+            # runtime's coordination client belongs to a dead group
+            log.info("Distributed runtime is from epoch %s; re-initializing "
+                     "under epoch %d", _init_epoch, my_epoch)
+            shutdown_distributed()
+        else:
+            return True                  # already initialized, same epoch
     if not cfg.machine_list_file:
         log.fatal("num_machines=%d but no machine_list_file given",
                   cfg.num_machines)
@@ -327,7 +455,8 @@ def init_distributed_from_config(cfg) -> bool:
     coordinator = f"{machines[0][0]}:{machines[0][1]}"
     log.info("Initializing distributed runtime: %d machines, rank %d, "
              "coordinator %s", len(machines), rank, coordinator)
-    init_distributed(coordinator, len(machines), rank)
+    init_distributed(coordinator, len(machines), rank,
+                     timeout=getattr(cfg, "collective_timeout", 0.0))
     return True
 
 

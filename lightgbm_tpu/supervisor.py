@@ -194,6 +194,7 @@ class Supervisor:
         self.machine_list_file = str(machine_list_file or "")
         self.hbm_budget = int(hbm_budget or 0)
         self._startup_failures: Dict[int, int] = {}
+        self._evicted_total = 0
         metrics_mod.register_source(self._metrics_samples)
 
     def _metrics_samples(self) -> list:
@@ -214,6 +215,12 @@ class Supervisor:
             # same value but is the documented, stable name a dashboard
             # alerts on — a drop in one scrape IS a shrink
             ("world_size", {}, float(self.world), "gauge"),
+            # evictions are permanent (a shrink never un-happens), so the
+            # counter + the world_size drop tell the whole story in one
+            # scrape; per-rank gauges below only cover LIVE ranks — an
+            # evicted rank's heartbeat gauge is dropped, not left to age
+            ("rank_evicted_total", {}, float(self._evicted_total),
+             "counter"),
         ]
         for r in range(self.world):
             hb = checkpoint_mod.read_heartbeat(
@@ -357,6 +364,10 @@ class Supervisor:
             try:
                 plan_mesh(new_world, int(manifest["num_data_global"]),
                           max(1, int(manifest.get("num_features", 1) or 1)),
+                          bins=max(1, int(manifest.get("max_bin", 255)
+                                          or 255)),
+                          leaves=max(2, int(manifest.get("num_leaves", 31)
+                                            or 31)),
                           num_class=max(1, int(manifest.get("num_class", 1)
                                                or 1)),
                           capacity=(self.hbm_budget
@@ -377,12 +388,31 @@ class Supervisor:
             if rank < len(machines):
                 del machines[rank]
                 mesh.write_machine_list(self.machine_list_file, machines)
+        old_world = self.world
         self.world = new_world
         self.attempt += 1
         self._startup_failures = {}
         self._restarts_since_progress = 0
         self._last_restart_unix = time.time()
+        self._evicted_total += 1
+        # metrics hygiene: the per-rank gauges iterate range(self.world),
+        # so the top index drops out of /metrics by renumbering alone —
+        # but the dead incarnation's top-index FILES (heartbeat, crash
+        # report, flight stream) must go too, or the next scrape-side
+        # consumer (or a later world GROWTH) reads a ghost
+        for r in range(new_world, old_world):
+            victims = [checkpoint_mod.heartbeat_path(self.output_model, r),
+                       checkpoint_mod.crash_report_path(self.output_model,
+                                                        r)]
+            if self.obs_stream:
+                victims.append(flight_mod.stream_path(self.obs_stream, r))
+            for path in victims:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
         counters.gauge("world_size", self.world)
+        counters.gauge("rank_evicted_total", self._evicted_total)
         counters.event("world_resize", world=self.world, evicted_rank=rank,
                        attempt=self.attempt, resume_iteration=it)
         log.warning("Supervisor: relaunching at world=%d (attempt %d) via "
@@ -401,6 +431,12 @@ class Supervisor:
             self.output_model, heartbeats=True,
             current_epoch=self.attempt,
             flight_base=self.obs_stream or "")
+        # the startup-barrier fence: stamp the group's current incarnation
+        # BEFORE spawning, so any straggler from a dead incarnation that
+        # reaches jax.distributed bring-up sees a newer stamped epoch and
+        # refuses the rendezvous (StaleEpochError) instead of wedging it
+        checkpoint_mod.write_group_epoch_file(self.output_model,
+                                              self.attempt)
         if self.prelaunch is not None:
             self.prelaunch(self)
         self._ranks = []

@@ -220,7 +220,15 @@ def mesh_rows(d):
     (bench_mesh.json) and the gspmd_hist fused-vs-flat rung
     (BENCH_MESH_FUSED=1, bench_mesh_fused.json).  A host-mesh rung: it
     compares the collective FORMULATIONS, so the ratios are
-    informational — on-TPU defaults await an on-chip pair."""
+    informational — on-TPU defaults await an on-chip pair.
+
+    Capability note (ISSUE 18): these rungs run SINGLE-process (one host
+    mesh over local devices).  The gspmd side now also serves real
+    multi-process elastic groups — ``parallel_impl=auto`` resolves to
+    gspmd across processes, and the supervisor re-plans its mesh on a
+    shrink — but a multi-host on-chip A/B of that path is still an open
+    rung; until it lands, these single-process numbers are the only
+    mesh evidence and decide nothing about the multi-process default."""
     m = d.get("mesh")
     if not isinstance(m, dict):
         return []

@@ -29,7 +29,9 @@ FAULTS = ("none", "nan_grad@2", "inf_hess@2", "hist_fail_once",
           "torn_checkpoint@4", "collective_fail_once", "preempt@2",
           "torn_shard_rank@4", "torn_manifest@4", "rank_crash_in_barrier@4",
           "rank_crash@3", "rank_hang@3", "slow_heartbeat", "rank_crash",
-          "stale_rejoin", "host_lost@4:rank=1", "host_lost@4:rank=1!strict")
+          "stale_rejoin", "host_lost@4:rank=1", "host_lost@4:rank=1!strict",
+          "host_lost@4:rank=1!gspmd", "rank_hang@4:rank=1!gspmd",
+          "host_lost@4:rank=1!gspmd_planfail")
 # multi-process snapshot-set faults: protocol-level cells driven through a
 # simulated 2-rank group (sequential ranks + a disk-backed gather stub, the
 # tests/test_robustness.py harness); expected outcomes below.  They do not
@@ -67,6 +69,16 @@ SUP_FAULTS = {                       # fault -> expected supervisor outcome
 ELASTIC_FAULTS = {                   # fault -> expected supervisor outcome
     "host_lost@4:rank=1": "shrunk",
     "host_lost@4:rank=1!strict": "budget_exhausted",
+    # the gspmd-vs-shardmap elastic parity cells: the bare cells above pin
+    # the shard_map path explicitly (parallel_impl=shardmap), the !gspmd
+    # variants run the SAME supervised group through the compiler-owned
+    # path — host_lost must shrink to the byte-identical model, a wedged
+    # GSPMD collective must surface as a hang_timeout verdict and restart
+    # (never a silent hang), and a shrink the mesh planner refuses must
+    # exit with a structured mesh_plan_failed, never a compile-time OOM
+    "host_lost@4:rank=1!gspmd": "shrunk",
+    "rank_hang@4:rank=1!gspmd": "recovered",
+    "host_lost@4:rank=1!gspmd_planfail": "mesh_plan_refused",
 }
 # the ~2-minute tier loop runs this subset (tests/test_robustness.py)
 FAST_CELLS = {("none", "raise"), ("nan_grad@2", "raise"),
@@ -75,7 +87,8 @@ FAST_CELLS = {("none", "raise"), ("nan_grad@2", "raise"),
               ("torn_shard_rank@4", "raise"), ("torn_manifest@4", "raise"),
               ("rank_crash_in_barrier@4", "raise"),
               ("rank_crash@3", "raise"), ("rank_hang@3", "raise"),
-              ("rank_crash", "raise"), ("stale_rejoin", "raise")}
+              ("rank_crash", "raise"), ("stale_rejoin", "raise"),
+              ("host_lost@4:rank=1!gspmd_planfail", "raise")}
 
 
 def _data():
@@ -412,14 +425,18 @@ def _run_sup_cell(fault: str, X, y, workdir: str) -> str:
 
 
 # the elastic worker: rank identity, world size, incarnation epoch, and the
-# host_lost fault all travel through the environment (the supervisor stamps
-# LGBM_TPU_WORLD / LGBM_TPU_GROUP_EPOCH per incarnation, the cell arms
-# LGBM_TPU_FAULT_INJECT once for every incarnation).  The data slice
-# follows the CURRENT world: at world=2 each rank trains its half, at
-# world=1 the survivor trains the union — exactly the partition the
-# elastic-resume path re-splices the committed 2-rank set onto.  Integer-
-# valued gradients keep f32 histogram sums exact under any summation
-# order, so "byte-identical across a topology change" is a meaningful pin.
+# fault all travel through the environment (the supervisor stamps
+# LGBM_TPU_WORLD / LGBM_TPU_GROUP_EPOCH per incarnation; the cell ships the
+# fault spec as EL_FAULT and the worker arms it as the ``fault_inject``
+# param — on the FIRST incarnation only, except ``host_lost`` whose
+# contract is precisely "dies again at startup in EVERY relaunch").  The
+# data slice follows the CURRENT world: at world=2 each rank trains its
+# half, at world=1 the survivor trains the union — exactly the partition
+# the elastic-resume path re-splices the committed 2-rank set onto.
+# Integer-valued gradients keep f32 histogram sums exact under any
+# summation order, so "byte-identical across a topology change" is a
+# meaningful pin.  EL_IMPL pins ``parallel_impl`` (shardmap for the legacy
+# cells, gspmd for the compiler-owned parity cells).
 ELASTIC_WORKER = r"""
 import os, sys
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
@@ -451,8 +468,14 @@ params = dict(objective="regression", num_leaves=7, min_data_in_leaf=10,
               output_model=os.environ["EL_SNAP"], snapshot_freq=2,
               snapshot_resume=True, heartbeat_interval=0.05,
               collective_timeout=4, collective_retries=0)
+if os.environ.get("EL_IMPL"):
+    params["parallel_impl"] = os.environ["EL_IMPL"]
 if os.environ.get("EL_ELASTIC") == "1":
     params["elastic_resume"] = True
+fault = os.environ.get("EL_FAULT", "")
+first = os.environ.get("LGBM_TPU_SUPERVISOR_ATTEMPT", "0") == "0"
+if fault and (first or "host_lost" in fault):
+    params["fault_inject"] = fault
 bst = lgb.train(params, lgb.Dataset(X[lo:hi], label=y[lo:hi],
                                     free_raw_data=False),
                 num_boost_round=6, verbose_eval=False, fobj=int_fobj)
@@ -493,19 +516,35 @@ def _elastic_serial_ref(workdir: str) -> str:
 def _run_elastic_cell(fault: str, workdir: str) -> str:
     """One elastic-group cell (expected outcomes: ELASTIC_FAULTS).
 
-    Timeline of the ``shrunk`` cell: attempt 0 loses rank 1 at boundary 4
+    Timeline of the ``shrunk`` cells: attempt 0 loses rank 1 at boundary 4
     (after the iteration-2 set committed, before 4 commits); attempts 1-2
     die at startup before a heartbeat (``host_lost`` re-arms per
     incarnation); the supervisor evicts rank 1, pre-flights the world=1
     mesh plan, and relaunches the survivor on the union through elastic
-    resume to the byte-identical uninterrupted model."""
+    resume to the byte-identical uninterrupted model.
+
+    Variants after ``!``: ``strict`` disables elastic resume (the
+    supervisor must give up, never shrink); ``gspmd`` runs the group under
+    the compiler-owned GSPMD grower instead of shard_map (shrink parity —
+    same byte-identical pin); ``gspmd_planfail`` caps the supervisor's
+    ``hbm_budget`` so the world=1 mesh pre-flight REFUSES: the run must
+    end with a structured ``mesh_plan_failed`` exit, not a compile-time
+    OOM in the shrunken world.  A hang fault (``rank_hang``) armed only on
+    the first incarnation exercises recovery-at-same-world: the wedged
+    GSPMD collective surfaces (peer CollectiveError death or heartbeat-age
+    verdict), the group restarts clean, and the world-2 result still
+    matches the uninterrupted baseline."""
     from lightgbm_tpu.obs.counters import counters
     from lightgbm_tpu.parallel import mesh
     from lightgbm_tpu.supervisor import Supervisor
 
-    strict = fault.endswith("!strict")
-    spec = fault[:-len("!strict")] if strict else fault
-    d = os.path.join(workdir, "elastic_strict" if strict else "elastic")
+    spec, _, variant = fault.partition("!")
+    strict = variant == "strict"
+    planfail = variant == "gspmd_planfail"
+    impl = "gspmd" if variant.startswith("gspmd") else "shardmap"
+    hang = spec.startswith("rank_hang")
+    d = os.path.join(workdir, "elastic_" + (variant or "legacy")
+                     + ("_hang" if hang else ""))
     os.makedirs(d, exist_ok=True)
     script = os.path.join(workdir, "elastic_worker.py")
     if not os.path.exists(script):
@@ -519,7 +558,7 @@ def _run_elastic_cell(fault: str, workdir: str) -> str:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {"EL_MLIST": mlist, "EL_SNAP": snap, "EL_OUT": out,
            "EL_ELASTIC": "" if strict else "1",
-           "LGBM_TPU_FAULT_INJECT": spec,
+           "EL_FAULT": spec, "EL_IMPL": impl,
            "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
            "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH",
                                                             "")}
@@ -531,7 +570,8 @@ def _run_elastic_cell(fault: str, workdir: str) -> str:
         term_grace=8.0, poll_interval=0.05, env=env,
         prelaunch=lambda _sup: mesh.refresh_local_ports(mlist),
         elastic_resume=not strict, world_shrink_after=2,
-        machine_list_file=mlist)
+        machine_list_file=mlist,
+        hbm_budget=(1 if planfail else 0))
     rc = sup.run()
     if strict:
         if rc == 0:
@@ -541,8 +581,40 @@ def _run_elastic_cell(fault: str, workdir: str) -> str:
         if counters.events("world_resize"):
             return "strict mode shrank the world"
         return "ok"
+    if planfail:
+        # the eviction decision stands (rank_evicted) but the world=1
+        # layout is unplannable under the budget — the run must stop with
+        # the structured refusal, never attempt the resize
+        if rc == 0:
+            return "supervisor completed despite an unplannable shrink"
+        if not counters.events("rank_evicted"):
+            return "no rank_evicted event before the refused shrink"
+        if not counters.events("mesh_plan_failed"):
+            return "no mesh_plan_failed event behind the refusal"
+        if counters.events("world_resize"):
+            return "world_resize fired despite the mesh-plan refusal"
+        return "ok"
     if rc != 0:
         return f"elastic supervisor gave up (exit {rc})"
+    if hang:
+        # recovery at the SAME world: the wedged collective must surface
+        # as a verdict (a peer's CollectiveError death or the heartbeat-
+        # age hang verdict), the group restarts, and nobody is evicted
+        if not (counters.events("rank_dead") or counters.events("rank_hang")):
+            return "no rank_dead/rank_hang verdict behind the wedge"
+        if not counters.events("group_restart"):
+            return "no group_restart event after the wedged collective"
+        if counters.events("world_resize"):
+            return "hang recovery shrank the world (should restart at 2)"
+        for r in (0, 1):
+            final = out + f".rank{r}.txt"
+            if not os.path.exists(final):
+                return f"no final model from rank {r} after recovery"
+            with open(final) as f:
+                if f.read() != _elastic_serial_ref(workdir):
+                    return (f"rank {r} model differs from uninterrupted "
+                            "run after hang recovery")
+        return "ok"
     if not counters.events("rank_evicted"):
         return "no rank_evicted event behind the shrink"
     resizes = counters.events("world_resize")

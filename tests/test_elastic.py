@@ -73,25 +73,44 @@ def int_fobj(preds, ds):
 
 rank = int(os.environ["LGBM_TPU_RANK"])
 lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
+if os.environ.get("EL_SWAP") == "1":
+    # re-partitioned job: each rank claims its old global row window but
+    # actually holds the OTHER half — the global fingerprint audit must
+    # catch the lie on resume
+    lo, hi = (n // 2, n) if rank == 0 else (0, n // 2)
 params = dict(objective="regression", num_leaves=15, min_data_in_leaf=10,
               learning_rate=0.5, verbose=-1, boost_from_average=False,
               tree_learner="data", num_machines=2,
               machine_list_file=os.environ["EL_MLIST"],
               output_model=os.environ["EL_OUT"])
+if os.environ.get("EL_IMPL"):
+    params["parallel_impl"] = os.environ["EL_IMPL"]
 if os.environ.get("EL_SNAPFREQ"):
     params["snapshot_freq"] = int(os.environ["EL_SNAPFREQ"])
 if os.environ.get("EL_RESUME") == "1":
     params["snapshot_resume"] = True
     params["elastic_resume"] = True
-bst = lgb.train(params, lgb.Dataset(X[lo:hi], label=y[lo:hi]),
-                num_boost_round=int(os.environ["EL_ROUNDS"]),
-                verbose_eval=False, fobj=int_fobj)
+expect = os.environ.get("EL_EXPECT", "")
+try:
+    bst = lgb.train(params, lgb.Dataset(X[lo:hi], label=y[lo:hi]),
+                    num_boost_round=int(os.environ["EL_ROUNDS"]),
+                    verbose_eval=False, fobj=int_fobj)
+except Exception as e:
+    from lightgbm_tpu.checkpoint import CheckpointError
+    assert expect, e
+    assert isinstance(e, CheckpointError), (type(e).__name__, e)
+    assert expect in str(e), e
+    print("EXPECTED_REJECT", rank)
+    print("ELASTIC_WORKER_OK", rank)
+    sys.exit(0)
+assert not expect, f"expected a {expect} rejection, but training ran"
 bst.save_model(os.environ["EL_OUT"] + f".final_{rank}")
 print("ELASTIC_WORKER_OK", rank)
 """
 
 
-def _run_pair(workdir, out, *, rounds, snapfreq=None, resume=False):
+def _run_pair(workdir, out, *, rounds, snapfreq=None, resume=False,
+              impl=None, swap=False, expect=None):
     script = os.path.join(workdir, "elastic_worker.py")
     with open(script, "w") as f:
         f.write(WORKER)
@@ -108,14 +127,19 @@ def _run_pair(workdir, out, *, rounds, snapfreq=None, resume=False):
                    EL_ROUNDS=str(rounds), JAX_PLATFORMS="cpu",
                    PALLAS_AXON_POOL_IPS="",
                    EL_SNAPFREQ=str(snapfreq) if snapfreq else "",
-                   EL_RESUME="1" if resume else "")
+                   EL_RESUME="1" if resume else "",
+                   EL_IMPL=impl or "", EL_SWAP="1" if swap else "",
+                   EL_EXPECT=expect or "")
         procs.append(subprocess.Popen([sys.executable, script],
                                       stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT, text=True,
                                       env=env))
+    outs = []
     for i, p in enumerate(procs):
         o, _ = p.communicate(timeout=300)
         assert p.returncode == 0, f"rank {i}:\n{o[-5000:]}"
+        outs.append(o)
+    return outs
 
 
 @pytest.fixture(scope="module")
@@ -216,6 +240,30 @@ def test_epoch_fence_unit():
     assert ei.value.frame_epoch == 5 and ei.value.group_epoch == 0
 
 
+def test_stale_incarnation_refused_at_startup_barrier(tmp_path,
+                                                      monkeypatch):
+    """ISSUE 18: the epoch fence extends to the ``jax.distributed``
+    STARTUP barrier — a worker launched under an older incarnation epoch
+    (the supervisor stamps the group's current epoch on disk per
+    relaunch) is refused BEFORE it can touch the new group's rendezvous,
+    with the same terminal StaleEpochError + structured event as the
+    per-payload fence."""
+    import types
+    out = str(tmp_path / "m.txt")
+    ck.write_group_epoch_file(out, 7)
+    assert ck.read_group_epoch_file(out) == 7
+    monkeypatch.setenv(ck.GROUP_EPOCH_ENV, "5")
+    cfg = types.SimpleNamespace(num_machines=2, output_model=out,
+                                machine_list_file="")
+    with pytest.raises(sync.StaleEpochError) as ei:
+        mesh.init_distributed_from_config(cfg)
+    assert ei.value.frame_epoch == 5 and ei.value.group_epoch == 7
+    assert "epoch 5" in str(ei.value) and "epoch 7" in str(ei.value)
+    evs = counters.events("stale_epoch_rejected")
+    assert evs and evs[-1]["op"] == "distributed_init"
+    assert evs[-1]["frame_epoch"] == 5 and evs[-1]["group_epoch"] == 7
+
+
 def test_elastic_armed_single_process_zero_collectives(tmp_path):
     """comm_audit contract: arming elastic_resume (snapshots + resume +
     the elastic finder) adds ZERO host-object collectives to
@@ -239,6 +287,83 @@ def test_elastic_armed_single_process_zero_collectives(tmp_path):
     assert counters.get("collective_bytes") == {}
 
 
+# ------------------------------- elastic GSPMD (ISSUE 18): topology errors
+
+@pytest.fixture(scope="module")
+def gspmd_two_rank_set(tmp_path_factory):
+    """A committed 2-rank elastic snapshot set at iteration 3, trained by
+    the compiler-owned GSPMD grower (multi-process ``parallel_impl=gspmd``
+    over the named (batch, feature) mesh)."""
+    d = tmp_path_factory.mktemp("elastic_gspmd_w2")
+    out = str(d / "model.txt")
+    _run_pair(str(d), out, rounds=3, snapfreq=3, impl="gspmd")
+    assert os.path.exists(ck.manifest_path(out, 3))
+    return out
+
+
+def _copy_set(src_out, dst_dir):
+    """Copy a snapshot-set prefix into ``dst_dir`` so a test can mutilate
+    its own copy without poisoning the module-scoped fixture."""
+    import shutil
+    src_dir = os.path.dirname(src_out)
+    for fn in os.listdir(src_dir):
+        p = os.path.join(src_dir, fn)
+        if os.path.isfile(p):
+            shutil.copy(p, os.path.join(str(dst_dir), fn))
+    return os.path.join(str(dst_dir), os.path.basename(src_out))
+
+
+def test_gspmd_strict_resume_refuses_topology_change(gspmd_two_rank_set):
+    """PR 12 pin mirrored onto a GSPMD-committed set: without
+    elastic_resume, the strict group resume treats a topology change as a
+    structured fatal naming the knob that would allow it."""
+    def gather1(payload):
+        ok, fatal = ck._local_valid_group_iters(gspmd_two_rank_set, 0, 1,
+                                                None)
+        return [{"rank": 0, "ok": ok, "fatal": fatal}]
+
+    with pytest.raises(ck.CheckpointError, match="elastic_resume"):
+        ck.find_latest_valid_group(gspmd_two_rank_set, rank=0, world=1,
+                                   fingerprint=None, gather=gather1)
+
+
+def test_gspmd_repartitioned_data_fails_fingerprint_audit(
+        gspmd_two_rank_set, tmp_path):
+    """Resuming a GSPMD group on RE-PARTITIONED data (each rank claims
+    its old global row window but holds the other half) must fail the
+    global fingerprint audit on ALL ranks — a structured CheckpointError
+    naming the fingerprint, not silent training on misattributed rows."""
+    out = _copy_set(gspmd_two_rank_set, tmp_path)
+    outs = _run_pair(str(tmp_path), out, rounds=5, resume=True,
+                     impl="gspmd", swap=True, expect="fingerprint")
+    for rank, o in enumerate(outs):
+        assert f"EXPECTED_REJECT {rank}" in o, o[-3000:]
+
+
+def test_gspmd_torn_shard_demotes_group(gspmd_two_rank_set, serial5,
+                                        tmp_path):
+    """A torn shard on ANY rank of the GSPMD-committed set demotes the
+    whole set for elastic resume (checkpoint_skipped, never half-loaded):
+    with no older set, the single-process job trains from scratch to the
+    byte-identical uninterrupted model."""
+    out = _copy_set(gspmd_two_rank_set, tmp_path)
+    shard = ck.shard_path(out, 3, 1)
+    with open(shard, "rb") as f:
+        data = f.read()
+    with open(shard, "wb") as f:
+        f.write(data[:len(data) // 2])
+    X, y = _problem()
+    params = dict(BASE, output_model=out, snapshot_resume=True,
+                  elastic_resume=True)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                    verbose_eval=False, fobj=_int_fobj)
+    assert bst.model_to_string(-1) == serial5
+    assert not counters.events("elastic_resume"), \
+        "the torn set was elastically loaded"
+    skips = counters.events("checkpoint_skipped")
+    assert skips and any(e["iteration"] == 3 for e in skips)
+
+
 # ------------------------------------------------- headline e2e (tier-1)
 
 def test_host_lost_heals_to_smaller_world_byte_identical(tmp_path):
@@ -254,6 +379,24 @@ def test_host_lost_heals_to_smaller_world_byte_identical(tmp_path):
     assert msg == "ok", msg
     # every decision along the way is a structured event
     assert counters.events("rank_dead")
+    evicted = counters.events("rank_evicted")
+    assert evicted and evicted[-1]["rank"] == 1
+    resizes = counters.events("world_resize")
+    assert resizes and resizes[-1]["world"] == 1
+
+
+def test_gspmd_host_lost_heals_to_smaller_world_byte_identical(tmp_path):
+    """ISSUE 18 acceptance pin: the same unattended heal under
+    multi-process GSPMD — a real 2-process compiler-owned group loses
+    rank 1's host (never respawned), the supervisor evicts it, re-plans
+    the mesh at world=1, and relaunches through elastic resume to the
+    byte-identical uninterrupted model.  Every decision is a structured
+    obs event; the cell itself verifies byte-identity against the
+    uninterrupted single-process baseline."""
+    import importlib
+    fm = importlib.import_module("scripts.fault_matrix")
+    msg = fm._run_elastic_cell("host_lost@4:rank=1!gspmd", str(tmp_path))
+    assert msg == "ok", msg
     evicted = counters.events("rank_evicted")
     assert evicted and evicted[-1]["rank"] == 1
     resizes = counters.events("world_resize")

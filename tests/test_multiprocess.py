@@ -163,6 +163,41 @@ if os.environ.get("TEST_MODE") == "ckpt":
         sys.exit(0)
     raise SystemExit(f"unknown ckpt phase {phase}")
 
+if os.environ.get("TEST_MODE") == "obs_parity":
+    # the zero-added-collectives pin extended over multi-process GSPMD
+    # (ISSUE 18): arming the full observability plane (telemetry + flight
+    # recorder + heartbeats) must add ZERO sync.py host-object collectives
+    # and ZERO new compiled-HLO collective ops to the training program —
+    # both compared armed-vs-unarmed inside the live 2-process group
+    from lightgbm_tpu.obs.counters import counters
+    lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
+    base = dict(objective="binary", num_leaves=15, min_data_in_leaf=10,
+                learning_rate=0.2, verbose=-1, tree_learner="data",
+                num_machines=2, machine_list_file=mlist,
+                parallel_impl="gspmd")
+
+    def run(extra):
+        d = lgb.Dataset(X[lo:hi], label=y[lo:hi], free_raw_data=False)
+        return lgb.train(dict(base, **extra), d, num_boost_round=3,
+                         verbose_eval=False)
+
+    run({"output_model": out + ".warm"})   # absorbs the one-time
+                                           # distributed bring-up traffic
+    counters.reset()
+    bst_plain = run({"output_model": out + ".plain"})
+    plain_calls = dict(counters.get("collective_calls"))
+    plain_census = bst_plain.inner.grow_hlo_census(label="parity")
+    counters.reset()
+    bst_armed = run({"output_model": out + ".armed", "telemetry": True,
+                     "obs_stream_path": os.environ["TEST_STREAM"],
+                     "heartbeat_interval": 0.01})
+    armed_calls = dict(counters.get("collective_calls"))
+    armed_census = bst_armed.inner.grow_hlo_census(label="parity")
+    assert armed_calls == plain_calls, (plain_calls, armed_calls)
+    assert armed_census == plain_census, (plain_census, armed_census)
+    print("WORKER_OK", rank)
+    sys.exit(0)
+
 # this process's row partition (pre-partitioned parallel learning)
 lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
 
@@ -425,6 +460,18 @@ def test_two_process_preempt_coordinated_exit(tmp_path):
         "TEST_CKPT_PHASE": "resume", "TEST_SNAP_OUT": str(snap / "m.txt")})
     assert (resume_dir / "model_0.txt").read_text() == \
         (ref_dir / "model_0.txt").read_text()
+
+
+@pytest.mark.skipif(os.environ.get("LGBM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess test disabled")
+def test_gspmd_armed_observability_adds_zero_collectives(tmp_path):
+    """ISSUE 18 satellite: under live 2-process GSPMD training, arming
+    telemetry + the flight recorder + heartbeats adds ZERO sync.py
+    host-object collectives and ZERO new compiled-HLO collective ops —
+    the workers compare an armed run against an unarmed one and fail
+    themselves on any delta."""
+    _run_workers(tmp_path, mode="obs_parity",
+                 extra_env={"TEST_STREAM": str(tmp_path / "flight")})
 
 
 def _free_port() -> int:

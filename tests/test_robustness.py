@@ -802,16 +802,20 @@ def test_checkpoint_skip_warnings_carry_events():
 def test_recovery_layer_swallows_carry_events():
     """Grep lint (the checkpoint-layer discipline extended over the
     self-healing layer, ISSUE 7 satellite): every ``except Exception`` /
-    ``except BaseException`` handler in supervisor.py and parallel/sync.py
-    must either re-raise or emit a structured obs record
-    (``counters.event`` / ``counters.inc`` / ``_note_late``) within its
-    block — a silent swallow in the recovery path is how an unattended
-    restart becomes an unexplainable one."""
+    ``except BaseException`` handler in supervisor.py, parallel/sync.py,
+    parallel/mesh.py, and parallel/gspmd.py must either re-raise or emit a
+    structured obs record (``counters.event`` / ``counters.inc`` /
+    ``_note_late``) within its block — a silent swallow in the recovery
+    path is how an unattended restart becomes an unexplainable one.  The
+    mesh/gspmd files joined the sweep when multi-process GSPMD made them
+    part of the elastic relaunch path (ISSUE 18)."""
     import re
     pkg = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "lightgbm_tpu")
     checked, missing = 0, []
-    for rel in ("supervisor.py", os.path.join("parallel", "sync.py")):
+    for rel in ("supervisor.py", os.path.join("parallel", "sync.py"),
+                os.path.join("parallel", "mesh.py"),
+                os.path.join("parallel", "gspmd.py")):
         with open(os.path.join(pkg, rel)) as f:
             src = f.read()
         lines = src.splitlines()

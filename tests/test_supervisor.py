@@ -152,6 +152,54 @@ def test_sweep_orphan_crash_reports_and_heartbeats(tmp_path):
                                       ckpt.heartbeat_path(out, 1)])
 
 
+def test_eviction_metrics_and_artifact_hygiene(tmp_path):
+    """ISSUE 18 satellite: evicting a rank updates every telemetry
+    surface in ONE scrape — the evicted rank's
+    ``rank_heartbeat_age_seconds`` gauge disappears (not left to age),
+    ``world_size`` drops, ``rank_evicted_total`` counts — and the dead
+    rank's heartbeat/crash-report files are swept from disk."""
+    from lightgbm_tpu.obs import metrics as obs_metrics
+
+    def lines(body, name):
+        return [ln for ln in body.splitlines()
+                if ln.startswith(obs_metrics.PREFIX + name)
+                and not ln.startswith("#")]
+
+    counters.reset()
+    out = str(tmp_path / "m.txt")
+    sup = sup_mod.Supervisor([sys.executable, "-c", "pass"], out, 2,
+                             elastic_resume=True)
+    for r in (0, 1):
+        ckpt.Heartbeat(ckpt.heartbeat_path(out, r), 0.0).stamp(3,
+                                                               force=True)
+    with open(ckpt.crash_report_path(out, 1), "w") as f:
+        f.write("boom")
+    body = obs_metrics.render_prometheus()
+    assert lines(body, 'rank_heartbeat_age_seconds{rank="0"}')
+    assert lines(body, 'rank_heartbeat_age_seconds{rank="1"}')
+    assert [float(ln.split()[-1]) for ln in lines(body, "world_size")] \
+        == [2.0]
+    assert [float(ln.split()[-1])
+            for ln in lines(body, "rank_evicted_total")] == [0.0]
+
+    sup._launch = lambda: None          # unit scope: no real relaunch
+    assert sup._shrink(1, "rank_dead", "exit code 70") is None
+    body = obs_metrics.render_prometheus()
+    assert lines(body, 'rank_heartbeat_age_seconds{rank="0"}')
+    assert not lines(body, 'rank_heartbeat_age_seconds{rank="1"}'), \
+        "the evicted rank's heartbeat gauge survived the scrape"
+    assert all(float(ln.split()[-1]) == 1.0
+               for ln in lines(body, "world_size"))
+    assert all(float(ln.split()[-1]) == 1.0
+               for ln in lines(body, "rank_evicted_total"))
+    # the dead incarnation's files went with it
+    assert os.path.exists(ckpt.heartbeat_path(out, 0))
+    assert not os.path.exists(ckpt.heartbeat_path(out, 1))
+    assert not os.path.exists(ckpt.crash_report_path(out, 1))
+    evs = counters.events("world_resize")
+    assert evs and evs[-1]["world"] == 1
+
+
 def test_group_resume_sweeps_stale_tmp_orphan_free(tmp_path):
     """Satellite pin: find_latest_valid_group leaves no dead-pid tmp
     leftovers behind — a crashed rank's half-written atomic tmp does not
