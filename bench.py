@@ -72,6 +72,14 @@ compiled-HLO collective census (op counts + bytes) embedded per
 configuration.  A host-mesh rung by construction (it A/Bs the
 formulations, not chip throughput); the capture playbook banks it as
 ``bench_mesh.json``.
+
+BENCH_STREAMED=1 switches to the ``streamed`` rung: resident-vs-chunked
+out-of-core training A/B over an artificial ``hbm_budget`` that forces
+the placement pre-flight to leave the binned matrix host-side and
+double-buffer it through the device (data/stream.py) — trees/s, rows/s,
+the measured pipeline stall fraction and the ``grower_jit_entries``
+zero-recompile pin per configuration; the capture playbook banks it as
+``bench_streamed.json``.
 """
 import json
 import os
@@ -514,6 +522,131 @@ def _mesh_rung_child():
     print(json.dumps(result))
 
 
+def _streamed_rung_child():
+    """The ``streamed`` rung (BENCH_STREAMED=1): resident-vs-chunked
+    out-of-core A/B under an ARTIFICIAL hbm_budget (docs/OBSERVABILITY.md
+    ``stream_*`` counters, data/stream.py pipeline).
+
+    One shape, two boosters over the SAME binned dataset: the classic
+    fully-device-resident baseline, then ``data_stream=auto`` with
+    ``hbm_budget`` scaled below the resident predicted peak so the
+    pre-flight placement walk MUST leave the binned matrix host-side and
+    stream it through the double-buffered block pipeline.  Per config:
+    trees/s, rows/s, the measured stall fraction (blocking wait on
+    incoming blocks / wall time — the pipeline's overlap evidence), the
+    ``grower_jit_entries`` zero-recompile pin across the chunk loop, and
+    the planner's ``PlacementPlan``.  A host rung by construction (CPU's
+    synchronous dispatch makes the stall fraction a conservative upper
+    bound — the TPU's async DMA only hides MORE of the copy); the
+    capture playbook banks it as ``bench_streamed.json``."""
+    import time
+
+    import jax
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import config_from_params
+    from lightgbm_tpu.obs import memory as obs_memory
+    from lightgbm_tpu.obs.counters import counters as obs_counters
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.utils import log as _log
+
+    _log.set_verbosity(-1)
+    rows = int(os.environ.get("BENCH_STREAMED_ROWS", 400_000))
+    feats = int(os.environ.get("BENCH_STREAMED_FEATURES", 28))
+    n_timed = int(os.environ.get("BENCH_STREAMED_TREES", 3))
+    chunk_pin = int(os.environ.get("BENCH_STREAMED_CHUNK", 0))
+    params = {
+        "objective": "binary",
+        "num_leaves": int(os.environ.get("BENCH_STREAMED_LEAVES", 63)),
+        "max_bin": int(os.environ.get("BENCH_STREAMED_MAX_BIN", 63)),
+        "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100,
+        "learning_rate": 0.1, "verbose": -1, "use_pallas": False,
+    }
+    cfg0 = config_from_params(params)
+    ds = _construct_cached(lambda: make_data(rows, feats, 0.0), cfg0,
+                           rows, feats, 0.0, params)
+    # the artificial budget: resident's predicted peak scaled down so
+    # resident refuses but a (possibly halved) chunk pipeline still fits
+    pred = obs_memory.predict_hbm(
+        rows=rows, features=int(ds.binned.shape[1]),
+        bins=params["max_bin"], leaves=params["num_leaves"],
+        bin_bytes=int(ds.binned.dtype.itemsize))
+    frac = float(os.environ.get("BENCH_STREAMED_BUDGET_FRACTION", 0.7))
+    budget = int(pred["peak_bytes"] * frac)
+    configs = [
+        ("resident", {"data_stream": "resident"}),
+        ("chunked", dict({"data_stream": "auto", "hbm_budget": budget},
+                         **({"stream_chunk_rows": chunk_pin}
+                            if chunk_pin else {}))),
+    ]
+    out = {}
+    for name, extra in configs:
+        cfg = config_from_params(dict(params, **extra))
+        try:
+            obs_counters.reset()
+            booster = create_boosting(cfg, ds, create_objective(cfg))
+            placements = obs_counters.events("placement_decision")
+            booster.train_one_iter()          # warmup (compile)
+            jax.block_until_ready(booster.scores)
+            streamer = booster._streamer
+            if streamer is not None:
+                streamer.take_wait_ms()       # drop warmup-pass waits
+            gauge_fn = getattr(booster.grow, "_cache_size", None)
+            entries_warm = gauge_fn() if gauge_fn else None
+            stalls_warm = obs_counters.total("stream_stalls")
+            t0 = time.perf_counter()
+            for _ in range(n_timed):
+                booster.train_one_iter()
+            jax.block_until_ready(booster.scores)
+            dt = (time.perf_counter() - t0) / n_timed
+            rec = {"trees_per_sec": round(1.0 / dt, 4),
+                   "rows_per_sec": round(rows / dt, 1)}
+            if streamer is not None:
+                wait_ms = streamer.take_wait_ms()
+                rec["stream_wait_ms_per_tree"] = round(wait_ms / n_timed, 3)
+                rec["stall_fraction"] = round(
+                    min(1.0, wait_ms / (dt * n_timed * 1e3)), 4)
+                rec["stalls"] = int(
+                    obs_counters.total("stream_stalls") - stalls_warm)
+                rec["blocks"] = streamer.store.num_blocks
+                rec["chunk_rows"] = streamer.store.chunk_rows
+            if gauge_fn is not None:
+                rec["grower_jit_entries"] = gauge_fn()
+                rec["zero_recompile"] = \
+                    rec["grower_jit_entries"] == entries_warm
+            plan = getattr(booster, "_placement", None)
+            if plan is not None:
+                rec["placement"] = {
+                    "mode": plan.mode, "chunk_rows": plan.chunk_rows,
+                    "peak_bytes": plan.peak_bytes,
+                    "capacity": plan.capacity}
+            elif placements:
+                rec["placement"] = placements[-1]
+            downs = obs_counters.events("layout_downgrade")
+            if downs:
+                rec["downgrades"] = downs
+            out[name] = rec
+        except Exception as e:       # one config never kills the rung
+            out[name] = {"error": str(e)[:200]}
+    r, c = out.get("resident", {}), out.get("chunked", {})
+    if "trees_per_sec" in r and "trees_per_sec" in c:
+        out["chunked_vs_resident"] = round(
+            c["trees_per_sec"] / r["trees_per_sec"], 3)
+    result = {
+        "metric": (f"streamed out-of-core training A/B "
+                   f"({rows // 1000}k x {feats}, artificial hbm_budget, "
+                   f"cpu host pipeline)"),
+        "value": c.get("trees_per_sec", 0.0),
+        "unit": "trees/sec",
+        "vs_baseline": None,
+        "streamed": {"rows": rows, "features": feats,
+                     "timed_trees": n_timed, "hbm_budget": budget,
+                     "budget_fraction": frac,
+                     "predicted_resident_peak": pred["peak_bytes"],
+                     "configs": out},
+    }
+    print(json.dumps(result))
+
+
 def child_main():
     """The measured workload.  Runs under BENCH_CHILD with the platform and
     histogram method fixed by the supervisor; prints the result JSON line."""
@@ -529,6 +662,13 @@ def child_main():
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
         _mesh_rung_child()
+        return
+    if mode == "streamed":
+        # the streamed rung is a host-pipeline A/B: one device, the
+        # binned matrix host-side, blocks flowing through device_put
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        _streamed_rung_child()
         return
     #                      fused | einsum | segment (cpu)
     use_pallas = mode == "fused"
@@ -982,6 +1122,19 @@ def main():
                 "metric": "mesh GSPMD-vs-shardmap data-parallel training",
                 "value": 0.0, "unit": "trees/sec", "vs_baseline": None,
                 "degraded": f"mesh rung failed: {res}",
+                "runner": _runner_record([rung], False)}))
+        return
+    if os.environ.get("BENCH_STREAMED") == "1":
+        # the streamed rung: resident-vs-chunked out-of-core A/B over an
+        # artificial hbm_budget — same single-child supervisor contract
+        res, rung = _run_child("cpu", "streamed", timeout_s)
+        if isinstance(res, dict):
+            print(json.dumps(res))
+        else:
+            print(json.dumps({
+                "metric": "streamed out-of-core training A/B",
+                "value": 0.0, "unit": "trees/sec", "vs_baseline": None,
+                "degraded": f"streamed rung failed: {res}",
                 "runner": _runner_record([rung], False)}))
         return
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))

@@ -239,6 +239,38 @@ class Dataset:
             if self.free_raw_data:
                 self.data = None
             return self
+        if isinstance(self.data, data_mod.CsrMatrix):
+            # sparse C-ABI ingest: two-round chunked binning — the full
+            # dense float64 matrix never materializes (data/sparse.py)
+            names = (list(self.feature_name)
+                     if isinstance(self.feature_name, (list, tuple))
+                     else None)
+            cat_idx: List[int] = []
+            if isinstance(self.categorical_feature, (list, tuple)):
+                for c in self.categorical_feature:
+                    if isinstance(c, str) and names and c in names:
+                        cat_idx.append(names.index(c))
+                    elif not isinstance(c, str):
+                        cat_idx.append(int(c))
+            ref = self.reference.construct(config)._constructed \
+                if self.reference is not None else None
+            self._constructed = data_mod.construct_csr(
+                self.data, cfg,
+                label=(None if self.label is None
+                       else np.asarray(self.label, np.float32).ravel()),
+                weight=(None if self.weight is None
+                        else np.asarray(self.weight)),
+                group=None if self.group is None else np.asarray(self.group),
+                init_score=(None if self.init_score is None
+                            else np.asarray(self.init_score)),
+                feature_names=names, categorical_features=cat_idx,
+                reference=ref)
+            self.raw = None
+            self._loaded_from_file = False
+            self._dist_sharded = False
+            if self.free_raw_data:
+                self.data = None
+            return self
         pd_cat_cols: List = []   # pandas category-dtype columns, by name
         if isinstance(self.data, (str, os.PathLike)):
             path = str(self.data)
@@ -456,6 +488,11 @@ class Dataset:
         that file still exists, is not itself a cache, and agrees with the
         constructed row count (guards against stale caches)."""
         if self.raw is not None:
+            return self.raw
+        if isinstance(self.data, data_mod.CsrMatrix):
+            # chunk-assembled full densify — only the consumers that
+            # genuinely need the whole matrix pay for it
+            self.raw = np.asarray(self.data)
             return self.raw
         if isinstance(self.data, (str, os.PathLike)) \
                 and not self._is_binary_cache(str(self.data)):
@@ -721,6 +758,8 @@ class Booster:
         inner.scores = None
         inner._subset_state = None
         inner._local_bins_cache = None
+        inner._stream_store = None
+        inner._streamer = None
         return self
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:

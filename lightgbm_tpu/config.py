@@ -281,6 +281,27 @@ class Config:
                                      # detected device capacity; > 0
                                      # raises BEFORE the grower compiles
                                      # when the predicted peak exceeds it
+    data_stream: str = "auto"        # training-data placement: resident
+                                     # keeps the binned matrix on device
+                                     # (the classic path); chunked streams
+                                     # host-side row blocks through a
+                                     # double-buffered device_put pipeline
+                                     # (data/stream.py + the streamed
+                                     # grower) so N_rows is no longer
+                                     # bounded by HBM; auto lets the
+                                     # pre-flight planner walk resident ->
+                                     # streamed -> sharded against
+                                     # hbm_budget (parallel/mesh.
+                                     # resolve_placement) before any
+                                     # compile
+    stream_chunk_rows: int = 0       # rows per streamed block when
+                                     # data_stream resolves to chunked; 0
+                                     # picks a default (262144 rows capped
+                                     # at ceil(rows/2) so even small
+                                     # datasets exercise >= 2 blocks).
+                                     # All blocks pad to this one static
+                                     # shape, so the chunk loop adds zero
+                                     # recompiles
     fault_inject: str = ""           # deterministic fault-injection spec,
                                      # e.g. nan_grad@3,torn_checkpoint@4,
                                      # collective_fail_once (utils/faults.py;
@@ -704,6 +725,19 @@ def check_param_conflicts(cfg: Config) -> None:
         log.fatal("hbm_budget must be >= 0 bytes (0 = warn-only pre-flight "
                   "against the detected device capacity); got %r",
                   cfg.hbm_budget)
+    if cfg.data_stream not in ("auto", "resident", "chunked"):
+        log.fatal("data_stream must be auto, resident, or chunked; got %r",
+                  cfg.data_stream)
+    if cfg.stream_chunk_rows < 0:
+        log.fatal("stream_chunk_rows must be >= 0 rows (0 = auto block "
+                  "size); got %r", cfg.stream_chunk_rows)
+    if cfg.data_stream == "chunked" \
+            and cfg.boosting_type in ("dart", "goss"):
+        log.fatal("data_stream=chunked is incompatible with "
+                  "boosting_type=%s: dart's drop/rescale and goss's top-k "
+                  "sampling assume the resident row layout; use "
+                  "data_stream=resident or boosting_type=gbdt",
+                  cfg.boosting_type)
     if cfg.collective_timeout <= 0:
         log.fatal("collective_timeout must be positive; got %r",
                   cfg.collective_timeout)

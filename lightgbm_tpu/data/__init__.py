@@ -1,4 +1,6 @@
-from . import binning, dataset, metadata, parser  # noqa: F401
+from . import binning, dataset, metadata, parser, sparse  # noqa: F401
 from .binning import BinMapper  # noqa: F401
-from .dataset import TrainingData, construct, construct_streamed  # noqa: F401
+from .dataset import (TrainingData, construct, construct_csr,  # noqa: F401
+                      construct_streamed)
 from .metadata import Metadata  # noqa: F401
+from .sparse import CsrMatrix  # noqa: F401

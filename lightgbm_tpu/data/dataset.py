@@ -67,6 +67,19 @@ class TrainingData:
             out["offset"] = np.asarray(self.layout.sub_offset, dtype=np.int32)
         return out
 
+    def to_blocks(self, chunk_rows: int):
+        """Block-resident variant of this dataset for streamed training
+        (``data_stream=chunked``): the binned matrix cut into
+        static-shape host row blocks a :class:`~.stream.BlockStreamer`
+        pipelines through the device (data/stream.py).  The matrix
+        itself stays host-side — full blocks are views, only the padded
+        tail is copied."""
+        from .stream import make_block_store
+        if self.binned is None:
+            log.fatal("Cannot build streamed blocks: dataset has no "
+                      "binned matrix")
+        return make_block_store(self.binned, chunk_rows)
+
     def max_num_bin(self) -> int:
         """Histogram width: max bins over PHYSICAL columns."""
         if self.layout is not None and self.layout.has_bundles:
@@ -363,6 +376,67 @@ def construct_streamed(path: str,
 
     _set_metadata(ds, num_data, labels if label is None else label,
                   weight, group, init_score)
+    return ds
+
+
+def construct_csr(csr,
+                  config: Config,
+                  label: Optional[np.ndarray] = None,
+                  weight: Optional[np.ndarray] = None,
+                  group: Optional[np.ndarray] = None,
+                  init_score: Optional[np.ndarray] = None,
+                  feature_names: Optional[Sequence[str]] = None,
+                  categorical_features: Optional[Sequence[int]] = None,
+                  reference: Optional[TrainingData] = None) -> TrainingData:
+    """Two-round construction from a host :class:`~.sparse.CsrMatrix`
+    without densifying it (the C-ABI sparse ingest).
+
+    Round 1 densifies ONLY the sampled rows — CSR rows are O(nnz) random
+    access, so unlike the text-file path no full pass is needed; round 2
+    streams budget-bounded dense chunks through :func:`_bin_rows`
+    straight into the final uint8/16 matrix.  Peak extra memory is the
+    sample matrix plus one chunk; the full ``[nrow, ncol]`` float64
+    matrix never exists.  Sample indices and ordering match the
+    in-memory path exactly, so the fitted mappers — and therefore the
+    trained model — are bit-identical to densify-then-construct."""
+    num_data, num_features = csr.shape
+    ds = TrainingData()
+    ds.num_data = num_data
+    ds.num_total_features = num_features
+    ds.feature_names = (list(feature_names) if feature_names
+                        else [f"Column_{i}" for i in range(num_features)])
+    cat_set = set(int(c) for c in (categorical_features or []))
+
+    if reference is not None:
+        ds.reference = reference
+        ds.bin_mappers = reference.bin_mappers
+        ds.used_features = reference.used_features
+        ds.feature_names = reference.feature_names
+        ds.layout = reference.layout
+        if num_features != reference.num_total_features:
+            log.fatal("Validation data has %d features, training data has %d",
+                      num_features, reference.num_total_features)
+    else:
+        sample_cnt = min(config.bin_construct_sample_cnt, num_data)
+        if sample_cnt < num_data:
+            rng = make_rng(config.data_random_seed)
+            sample_idx = sample_k(rng, num_data, sample_cnt)
+        else:
+            sample_idx = np.arange(num_data)
+        sample = csr.rows(sample_idx)
+        _fit_from_sample(ds, sample, config, cat_set)
+        del sample
+
+    dtype = np.uint8 if ds.max_num_bin() <= 256 else np.uint16
+    ncols = (ds.layout.num_columns
+             if ds.layout is not None and ds.layout.has_bundles
+             else len(ds.used_features))
+    binned = np.empty((num_data, ncols), dtype=dtype)
+    for r0, block in csr.iter_dense_chunks():
+        _bin_rows(ds, block, binned[r0:r0 + len(block)])
+    ds.binned = binned
+
+    _set_metadata(ds, num_data, label, weight, group, init_score)
     return ds
 
 

@@ -1,0 +1,115 @@
+"""Host-side CSR container: bounded-peak densification for the C ABI.
+
+The framework's device storage IS dense binned columns (SURVEY §7: TPUs
+have no fast gather/scatter; EFB re-compresses mutually-exclusive sparse
+columns at construct) — but getting from a sparse C-API matrix to those
+uint8 columns used to materialize the FULL ``[nrow, ncol]`` float64
+matrix first: an 8-byte-per-cell spike dwarfing both the nnz-sized
+source and the 1-byte-per-cell destination.  :class:`CsrMatrix` keeps
+the copied CSR triplet host-side and densifies one bounded row chunk at
+a time (:data:`CSR_CHUNK_BUDGET_BYTES`), so dataset construction
+(``dataset.construct_csr`` bins each chunk straight into the final
+uint8/16 matrix), PushRows ingest and predict all peak at one chunk's
+worth of dense float64, never the whole matrix.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+# dense-densify working-set ceiling: one yielded chunk is at most this
+# many bytes of float64 (the peak the memory-budget test pins)
+CSR_CHUNK_BUDGET_BYTES = 64 << 20
+
+
+def csr_chunk_rows(ncol: int, budget_bytes: Optional[int] = None) -> int:
+    """Rows per dense chunk so one chunk stays under the byte budget."""
+    budget = CSR_CHUNK_BUDGET_BYTES if budget_bytes is None else budget_bytes
+    return max(1, int(budget) // max(1, int(ncol) * 8))
+
+
+class CsrMatrix:
+    """Copied CSR triplet (``indptr``/``indices``/``data``) + shape.
+
+    Buffers are copied on construction — C-ABI callers may free theirs
+    the moment the call returns (reference ``LGBM_DatasetCreateFromCSR``
+    contract).  ``np.asarray`` still works (full chunk-assembled
+    densify) so legacy consumers that genuinely need the whole matrix —
+    cv, subset, continued training — keep functioning; the construction
+    / push / predict fast paths never call it."""
+
+    def __init__(self, indptr, indices, data, ncol: int):
+        self.indptr = np.array(indptr, dtype=np.int64, copy=True)
+        self.indices = np.array(indices, dtype=np.int64, copy=True)
+        self.data = np.array(data, dtype=np.float64, copy=True)
+        if self.indptr.ndim != 1 or len(self.indptr) < 1:
+            raise ValueError("CSR indptr must be a non-empty 1-D array")
+        nnz = int(self.indptr[-1])
+        if nnz != len(self.indices) or nnz != len(self.data):
+            raise ValueError(
+                f"CSR buffers disagree: indptr ends at {nnz}, "
+                f"{len(self.indices)} indices / {len(self.data)} values")
+        self.nrow = len(self.indptr) - 1
+        self.ncol = int(ncol)
+        self.shape: Tuple[int, int] = (self.nrow, self.ncol)
+
+    @property
+    def nnz(self) -> int:
+        return len(self.data)
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes the triplet holds (the sparse footprint the chunked
+        densify keeps us near)."""
+        return int(self.indptr.nbytes + self.indices.nbytes
+                   + self.data.nbytes)
+
+    def __len__(self) -> int:
+        return self.nrow
+
+    def rows(self, idx) -> np.ndarray:
+        """Dense float64 ``[len(idx), ncol]`` of the selected rows, in
+        the given order — CSR rows are O(nnz_row) random access, so the
+        bin-mapper sample pass needs no full densify."""
+        idx = np.asarray(idx, dtype=np.int64)
+        counts = self.indptr[idx + 1] - self.indptr[idx]
+        out = np.zeros((len(idx), self.ncol), dtype=np.float64)
+        total = int(counts.sum())
+        if total:
+            # element e of the gather = row_start[its row] + its rank
+            # within that row, all vectorized
+            offs = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            take = (np.repeat(self.indptr[idx], counts)
+                    + np.arange(total) - np.repeat(offs, counts))
+            out[np.repeat(np.arange(len(idx)), counts),
+                self.indices[take]] = self.data[take]
+        return out
+
+    def iter_dense_chunks(
+            self, chunk_rows: Optional[int] = None,
+    ) -> Iterator[Tuple[int, np.ndarray]]:
+        """Yield ``(row0, dense_chunk)`` pairs covering every row once;
+        each chunk is at most ``chunk_rows`` (budget-derived by default)
+        rows of dense float64 — the bounded working set that replaces
+        the old full-matrix densify."""
+        chunk = (csr_chunk_rows(self.ncol) if chunk_rows is None
+                 else max(1, int(chunk_rows)))
+        for r0 in range(0, self.nrow, chunk):
+            r1 = min(self.nrow, r0 + chunk)
+            lo = int(self.indptr[r0])
+            hi = int(self.indptr[r1])
+            block = np.zeros((r1 - r0, self.ncol), dtype=np.float64)
+            row_of = np.repeat(np.arange(r1 - r0),
+                               np.diff(self.indptr[r0:r1 + 1]))
+            block[row_of, self.indices[lo:hi]] = self.data[lo:hi]
+            yield r0, block
+
+    def __array__(self, dtype=None, copy=None):
+        """Full densify, chunk-assembled (compat fallback only)."""
+        out = np.zeros(self.shape, dtype=np.float64)
+        for r0, block in self.iter_dense_chunks():
+            out[r0:r0 + len(block)] = block
+        if dtype is not None:
+            out = out.astype(dtype, copy=False)
+        return out

@@ -1,0 +1,175 @@
+"""Streamed out-of-core training data: host-side row blocks + the
+double-buffered host->device transfer pipeline (``data_stream=chunked``).
+
+The classic path uploads the whole binned matrix to HBM before iteration
+0, so N_rows is bounded by device memory.  The block-distributed GBT
+formulation (PAPERS.md) shows the natural out-of-core decomposition:
+histogram accumulation is a sum over row blocks, so the quantized bins
+can stay HOST-side and flow through the device one static-shape block at
+a time — the reference's own OrderedBin / two-round loader exists for
+exactly this "data never fits where the math runs" regime.
+
+Two pieces, both placement-only (zero math):
+
+* :class:`HostBlockStore` — the binned ``[N, F]`` matrix cut into
+  ``chunk_rows``-row blocks, every block padded to ONE static shape
+  (pad rows are bin 0 with a per-block ``valid`` count masking their
+  weights), so the chunk loop adds zero recompiles.
+* :class:`BlockStreamer` — the double-buffered async ``device_put``
+  pipeline: block k+1's transfer is issued BEFORE block k is consumed,
+  so the copy overlaps the grow step's histogram work.  Every wait on an
+  incoming block is measured (``stream_wait_ms`` counter,
+  ``chunks_in_flight`` gauge) and a blocking wait past the stall
+  threshold lands as one structured ``stream_stall`` event — the stall
+  fraction those feed is the bench rung's overlap evidence
+  (docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs.counters import counters
+from ..utils import log
+
+# a wait longer than this on an incoming block counts as a pipeline
+# stall (the transfer did not hide behind the previous block's compute);
+# sub-millisecond waits are dispatch noise, not serialization
+STALL_THRESHOLD_MS = 1.0
+
+
+class HostBlockStore:
+    """The binned matrix as host-side static-shape row blocks.
+
+    Full blocks are VIEWS of the source matrix (no host copy); only the
+    short final block is materialized padded.  Pad rows are bin 0 —
+    harmless because the streamed grower zeroes their (g, h, c) weights
+    through the ``valid`` count before any histogram sees them."""
+
+    def __init__(self, binned: np.ndarray, chunk_rows: int):
+        binned = np.ascontiguousarray(binned)
+        if binned.ndim != 2:
+            raise ValueError("HostBlockStore needs a [N, F] binned matrix")
+        n, f = binned.shape
+        chunk = max(1, min(int(chunk_rows), n))
+        self.num_rows = n
+        self.num_cols = f
+        self.dtype = binned.dtype
+        self.chunk_rows = chunk
+        self.num_blocks = -(-n // chunk)
+        self.padded_rows = self.num_blocks * chunk
+        self._binned = binned
+        self._tail: Optional[np.ndarray] = None
+        tail_valid = n - (self.num_blocks - 1) * chunk
+        if tail_valid < chunk:
+            tail = np.zeros((chunk, f), dtype=binned.dtype)
+            tail[:tail_valid] = binned[(self.num_blocks - 1) * chunk:]
+            self._tail = tail
+        self.valid: List[int] = [chunk] * (self.num_blocks - 1) + [tail_valid]
+
+    def block(self, k: int) -> np.ndarray:
+        """Block ``k`` as a ``[chunk_rows, F]`` host array (padded)."""
+        if self._tail is not None and k == self.num_blocks - 1:
+            return self._tail
+        start = k * self.chunk_rows
+        return self._binned[start:start + self.chunk_rows]
+
+    def nbytes(self) -> int:
+        """Host bytes the store holds beyond the source matrix (the
+        padded tail copy only)."""
+        return int(self._tail.nbytes) if self._tail is not None else 0
+
+
+class BlockStreamer:
+    """Double-buffered async host->device pipeline over a
+    :class:`HostBlockStore`.
+
+    One :meth:`blocks` pass yields ``(k, device_block, valid)`` per
+    block; before block k is handed out, block k+1's ``device_put`` has
+    already been issued, so under an async-dispatch backend (TPU) the
+    DMA runs while the caller computes on block k.  The wait for the
+    incoming block is measured per block and accumulated — callers read
+    :meth:`take_wait_ms` per tree/iteration to derive the stall
+    fraction."""
+
+    def __init__(self, store: HostBlockStore, device=None,
+                 stall_threshold_ms: float = STALL_THRESHOLD_MS):
+        import jax
+        self.store = store
+        self.device = device if device is not None else jax.devices()[0]
+        self.stall_threshold_ms = float(stall_threshold_ms)
+        self.wait_ms = 0.0          # cumulative across all passes
+        self.stall_events = 0
+        self.passes = 0
+        self._wait_since_take = 0.0
+
+    def _put(self, k: int):
+        import jax
+        return jax.device_put(self.store.block(k), self.device)
+
+    def blocks(self) -> Iterator[Tuple[int, object, int]]:
+        """One full pass over the store, double buffered."""
+        nb = self.store.num_blocks
+        if nb == 0:
+            return
+        inflight = self._put(0)
+        for k in range(nb):
+            nxt = self._put(k + 1) if k + 1 < nb else None
+            counters.gauge("chunks_in_flight", 1 + (nxt is not None))
+            t0 = time.perf_counter()
+            was_ready = self._is_ready(inflight)
+            try:
+                inflight.block_until_ready()
+            except AttributeError:      # non-jax array (test doubles)
+                pass
+            wait_ms = (time.perf_counter() - t0) * 1e3
+            self.wait_ms += wait_ms
+            self._wait_since_take += wait_ms
+            counters.inc("stream_wait_ms", wait_ms)
+            if was_ready is False and wait_ms > self.stall_threshold_ms:
+                # the grow step is BLOCKED on this transfer: the copy of
+                # block k did not hide behind block k-1's compute
+                self.stall_events += 1
+                counters.inc("stream_stalls")
+                counters.event("stream_stall", block=k,
+                               wait_ms=round(wait_ms, 3),
+                               pass_index=self.passes,
+                               chunk_rows=self.store.chunk_rows)
+            yield k, inflight, self.store.valid[k]
+            inflight = nxt
+        counters.gauge("chunks_in_flight", 0)
+        self.passes += 1
+
+    @staticmethod
+    def _is_ready(arr) -> Optional[bool]:
+        """Whether the transfer already completed (None when the backend
+        does not expose readiness — then only the measured wait
+        decides)."""
+        probe = getattr(arr, "is_ready", None)
+        if probe is None:
+            return None
+        try:
+            return bool(probe())
+        except Exception:
+            return None
+
+    def take_wait_ms(self) -> float:
+        """Wait accumulated since the last take (per-tree stall
+        numerator; the caller supplies the wall-clock denominator)."""
+        w, self._wait_since_take = self._wait_since_take, 0.0
+        return w
+
+
+def make_block_store(binned: np.ndarray, chunk_rows: int,
+                     context: str = "") -> HostBlockStore:
+    """Build the host block store and log the pipeline shape once."""
+    store = HostBlockStore(binned, chunk_rows)
+    log.info("Streamed data pipeline%s: %d rows x %d cols in %d block(s) "
+             "of %d rows (%.1f MB/block, double-buffered)",
+             f" ({context})" if context else "", store.num_rows,
+             store.num_cols, store.num_blocks, store.chunk_rows,
+             store.chunk_rows * store.num_cols
+             * store._binned.dtype.itemsize / 1e6)
+    return store

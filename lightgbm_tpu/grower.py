@@ -45,7 +45,8 @@ from .data.packing import (PACK_JOINT_BINS, pack_fused_panel,
                            unpack_gather_words)
 from .obs import trace as obs_trace
 from .obs.counters import counters as obs_counters
-from .ops.histogram import on_tpu, subset_histogram, subset_histogram_fused
+from .ops.histogram import (on_tpu, subset_histogram, subset_histogram_flat,
+                            subset_histogram_fused)
 from .ops.pallas_hist import FUSED_MAX_COLS, NIB, fused_idx_fetch
 from .ops.split import (MISSING_NAN, MISSING_ZERO, SplitConfig, SplitResult,
                         best_split, leaf_output, make_fused_ctx)
@@ -1156,3 +1157,391 @@ def make_grower(cfg: GrowerConfig, strategy=None, pack_plan=None,
     def grow_tree_packed(bins, hist_bins, gw, hw, cw, meta, feat_valid):
         return grow_impl(bins, hist_bins, gw, hw, cw, meta, feat_valid)
     return grow_tree_packed
+
+
+class StreamedGrower:
+    """Host-driven streamed grow loop (``data_stream=chunked``).
+
+    The resident growers keep the whole split loop inside one jitted
+    ``lax.while_loop`` because the binned matrix is device-resident.
+    Out-of-core that is impossible — each split's smaller-child histogram
+    needs a pass over ALL row blocks, and blocks arrive through the
+    double-buffered :class:`~.data.stream.BlockStreamer` pipeline — so
+    the loop moves to the HOST, built from four jitted pieces whose
+    compilation count is static (the ``grower_jit_entries`` gauge pins
+    the chunk loop at zero recompiles):
+
+    * ``_block_step`` — routing + per-block partial histogram for ONE
+      static-shape block: applies the pending split to the block's
+      ``row_leaf`` slice (the exact :func:`route_goes_left` sequence the
+      resident growers use), then masks the smaller child and
+      scatter-adds its partial ``[F, B, 3]`` histogram into the carried
+      accumulator.  Block partials accumulate in fixed block order, so
+      trees are byte-identical to the resident path under
+      order-insensitive (integer) weights — the same summation-order
+      discipline the GSPMD path pins (``parallel/gspmd.py``);
+    * ``_prep`` — reads the split pool and emits the pending split's
+      parameters as device scalars (no host round-trip);
+    * ``_root`` / ``_apply_split`` — the GSPMD body's bookkeeping minus
+      the row ops: parent-subtraction, packed tree writes, the vmapped
+      two-child ``best_split``, pool updates, and the continue flag —
+      the ONE scalar the host reads per split;
+    * ``_finalize`` — packed carriers -> :class:`TreeArrays` plus the
+      per-block ``row_leaf`` vectors concatenated into the grow
+      contract's ``[N]`` map.
+
+    Call contract matches the serial grower's product with the
+    device-resident matrix replaced by the streamer:
+    ``grower(streamer, gw, hw, cw, meta, feat_valid) -> (TreeArrays,
+    row_leaf)``.  Restrictions (gated loudly in ``boosting``): serial
+    single-device, raw-bin layout only (no pack plan / fused panel —
+    the per-tree weights those embed cannot be host-pre-packed ahead of
+    the tree), no ordered_bins."""
+
+    def __init__(self, cfg: GrowerConfig):
+        self.cfg = cfg
+        L = cfg.num_leaves
+        hist_width = cfg.max_bin
+
+        def _find(meta, feat_valid, hist, pg, ph, pc, feat_ok):
+            maps = (make_expand_maps(meta, cfg.max_bin)
+                    if meta.col is not None else None)
+            scfg = cfg.split_config()
+            fctx = (make_fused_ctx(meta.num_bin, meta.missing_type,
+                                   meta.default_bin, cfg.max_bin, scfg)
+                    if scfg.split_find == "fused" else None)
+            obs_counters.inc("split_find_dispatch", impl=cfg.split_find)
+            with jax.named_scope("split_find"):
+                if maps is not None:
+                    hist = expand_bundle_hist(hist, pg, ph, pc, maps)
+                return best_split(hist, pg, ph, pc, meta.num_bin,
+                                  meta.missing_type, meta.default_bin,
+                                  feat_valid & feat_ok, scfg,
+                                  is_cat=meta.is_categorical,
+                                  with_feat_ok=True, fused_ctx=fctx)
+
+        def block_step(bins_blk, rl_blk, gp, hp, cp, start, meta,
+                       l, new_leaf, feat, thr, dleft, cat_is, cat_row,
+                       small_id, valid, acc):
+            """Route the pending split over one block, then accumulate
+            the smaller child's partial histogram.  ``l = -1`` (the root
+            pass) matches no row, so routing is the identity and
+            ``small_id = 0`` histograms every valid row at the root."""
+            c_rows = bins_blk.shape[0]
+            dtype = gp.dtype
+            col_idx = feat if meta.col is None else meta.col[feat]
+            binf = lax.dynamic_index_in_dim(
+                bins_blk, col_idx, axis=1, keepdims=False).astype(jnp.int32)
+            with jax.named_scope("partition"):
+                goes_left = route_goes_left(
+                    binf, meta, feat, thr, dleft,
+                    has_categorical=cfg.has_categorical,
+                    is_cat_l=cat_is if cfg.has_categorical else None,
+                    cat_row=cat_row if cfg.has_categorical else None,
+                    max_bin=cfg.max_bin)
+                in_l = rl_blk == l
+                rl_blk = jnp.where(in_l,
+                                   jnp.where(goes_left, l, new_leaf),
+                                   rl_blk)
+            g_blk = lax.dynamic_slice(gp, (start,), (c_rows,))
+            h_blk = lax.dynamic_slice(hp, (start,), (c_rows,))
+            c_blk = lax.dynamic_slice(cp, (start,), (c_rows,))
+            mask = ((rl_blk == small_id)
+                    & (jnp.arange(c_rows, dtype=jnp.int32) < valid)
+                    ).astype(dtype)
+            with jax.named_scope("histogram"):
+                part = subset_histogram_flat(bins_blk, g_blk * mask,
+                                             h_blk * mask, c_blk * mask,
+                                             hist_width, site="stream")
+            return rl_blk, acc + part
+
+        def root(hist_root, gp, hp, cp, meta, feat_valid):
+            dtype = gp.dtype
+            num_logical = meta.num_bin.shape[0]
+            fh = hist_root.shape[0]
+            root_g = jnp.sum(gp)
+            root_h = jnp.sum(hp)
+            root_c = jnp.sum(cp)
+            res_root, root_feat_ok = _find(meta, feat_valid, hist_root,
+                                           root_g, root_h, root_c,
+                                           jnp.ones((num_logical,), bool))
+            res_root = _depth_gate(res_root, jnp.asarray(0), cfg.max_depth)
+            hist_store0 = jnp.zeros((L, fh, cfg.max_bin, 3), dtype) \
+                .at[0].set(hist_root)
+            feat_ok0 = jnp.zeros((L, num_logical), bool).at[0].set(
+                root_feat_ok)
+            root_f32, root_i32 = pool_rows(res_root, 0)
+            sgain0 = jnp.full((L,), -jnp.inf,
+                              res_root.gain.dtype).at[0].set(res_root.gain)
+            sf32_0 = jnp.zeros((L, 8), dtype).at[0].set(root_f32)
+            si32_0 = jnp.zeros((L, 3), jnp.int32).at[0].set(root_i32)
+            if cfg.has_categorical:
+                scat0 = jnp.zeros((L,), bool).at[0].set(res_root.is_cat)
+                scatb0 = jnp.zeros((L, cfg.max_bin), bool).at[0].set(
+                    res_root.cat_bins)
+                tcat0 = jnp.zeros((L - 1,), bool)
+                tcatb0 = jnp.zeros((L - 1, cfg.max_bin), bool)
+            else:
+                scat0 = jnp.zeros((0,), bool)
+                scatb0 = jnp.zeros((0, 0), bool)
+                tcat0 = jnp.zeros((0,), bool)
+                tcatb0 = jnp.zeros((0, 0), bool)
+            tnf0 = jnp.zeros((L - 1, 3), dtype)
+            tni0 = jnp.zeros((L - 1, 5), jnp.int32)
+            tlf0 = jnp.zeros((L, 2), dtype).at[0, 1].set(root_c)
+            tli0 = jnp.concatenate([jnp.full((L, 1), -1, jnp.int32),
+                                    jnp.zeros((L, 1), jnp.int32)], axis=1)
+            state = (sgain0, sf32_0, si32_0, scat0, scatb0, hist_store0,
+                     feat_ok0, tnf0, tni0, tlf0, tli0, tcat0, tcatb0)
+            cont = (L > 1) & (jnp.max(sgain0) > 0.0)
+            return state, cont
+
+        def prep(sgain, sf32, si32, scat, scatb, step):
+            """The pending split's parameters as device scalars — fed
+            straight into the block passes, no host read."""
+            l = jnp.argmax(sgain).astype(jnp.int32)
+            new_leaf = jnp.asarray(step + 1, jnp.int32)
+            irow = lax.dynamic_index_in_dim(si32, l, axis=0, keepdims=False)
+            frow = lax.dynamic_index_in_dim(sf32, l, axis=0, keepdims=False)
+            small_left = frow[2] <= frow[5]
+            small_id = jnp.where(small_left, l, new_leaf)
+            if cfg.has_categorical:
+                cat_is, cat_row = scat[l], scatb[l]
+            else:
+                cat_is = jnp.asarray(False)
+                cat_row = jnp.zeros((cfg.max_bin,), bool)
+            return (l, new_leaf, irow[0], irow[1], irow[2].astype(bool),
+                    cat_is, cat_row, small_id)
+
+        def apply_split(state, hist_small, i, meta, feat_valid):
+            """Everything the GSPMD body does AFTER its histogram —
+            parent subtraction, packed tree writes, the vmapped
+            two-child find, pool updates — plus the continue flag the
+            host reads once per split."""
+            (sgain, sf32, si32, scat, scatb, hist_store, feat_ok,
+             tnf, tni, tlf, tli, tcat, tcatb) = state
+            l = jnp.argmax(sgain).astype(jnp.int32)
+            new_leaf = jnp.asarray(i + 1, jnp.int32)
+            node = jnp.asarray(i, jnp.int32)
+            pair_lr = jnp.stack([l, new_leaf])
+            irow = lax.dynamic_index_in_dim(si32, l, axis=0, keepdims=False)
+            frow = lax.dynamic_index_in_dim(sf32, l, axis=0, keepdims=False)
+            feat, thr = irow[0], irow[1]
+            cat_args = ((scat[l], scatb[l]) if cfg.has_categorical else ())
+
+            prow = lax.dynamic_index_in_dim(tli, l, axis=0, keepdims=False)
+            parent_node = prow[0]
+            child_depth = prow[1] + 1
+            pn_safe = jnp.where(parent_node >= 0, parent_node, node)
+            side = jnp.where(tni[pn_safe, 3] == ~l, 3, 4)
+            tni = tni.at[pn_safe, side].set(node, mode="promise_in_bounds")
+            tni = tni.at[node].set(
+                jnp.stack([feat, thr, irow[2], ~l, ~new_leaf]),
+                mode="promise_in_bounds")
+            parent_g = frow[0] + frow[3]
+            parent_h = frow[1] + frow[4]
+            tnf = tnf.at[node].set(
+                jnp.stack([sgain[l],
+                           leaf_output(parent_g, parent_h,
+                                       cfg.lambda_l1, cfg.lambda_l2),
+                           tlf[l, 1]]),
+                mode="promise_in_bounds")
+            tlf = tlf.at[pair_lr].set(
+                jnp.stack([jnp.stack([frow[6], frow[2]]),
+                           jnp.stack([frow[7], frow[5]])]),
+                unique_indices=True, mode="promise_in_bounds")
+            tli = tli.at[pair_lr].set(
+                jnp.broadcast_to(jnp.stack([node, child_depth]), (2, 2)),
+                unique_indices=True, mode="promise_in_bounds")
+            if cfg.has_categorical:
+                tcat = tcat.at[node].set(cat_args[0],
+                                         mode="promise_in_bounds")
+                tcatb = tcatb.at[node].set(cat_args[1],
+                                           mode="promise_in_bounds")
+
+            small_left = frow[2] <= frow[5]
+            hist_parent = lax.dynamic_index_in_dim(hist_store, l, axis=0,
+                                                   keepdims=False)
+            hist_large = hist_parent - hist_small
+            hist2 = jnp.stack([hist_small, hist_large])
+            pair_sl = jnp.where(small_left, pair_lr, pair_lr[::-1])
+            hist_store = hist_store.at[pair_sl].set(
+                hist2, unique_indices=True, mode="promise_in_bounds")
+
+            fok_parent = lax.dynamic_index_in_dim(feat_ok, l, axis=0,
+                                                  keepdims=False)
+            lr3 = jnp.stack([lax.slice(frow, (0,), (3,)),
+                             lax.slice(frow, (3,), (6,))])
+            sl3 = jnp.where(small_left, lr3, lr3[::-1])
+            res2, fok2 = jax.vmap(
+                lambda h, pg, ph, pc, fo: _find(meta, feat_valid, h, pg,
+                                                ph, pc, fo),
+                in_axes=(0, 0, 0, 0, None))(
+                hist2, sl3[:, 0], sl3[:, 1], sl3[:, 2], fok_parent)
+            res2 = _depth_gate(res2, child_depth, cfg.max_depth)
+            feat_ok = feat_ok.at[pair_sl].set(fok2 & fok_parent[None, :],
+                                              unique_indices=True)
+            rows_f32, rows_i32 = pool_rows(res2, 1)
+            sgain = sgain.at[pair_sl].set(
+                res2.gain, unique_indices=True, mode="promise_in_bounds")
+            sf32 = sf32.at[pair_sl].set(
+                rows_f32, unique_indices=True, mode="promise_in_bounds")
+            si32 = si32.at[pair_sl].set(
+                rows_i32, unique_indices=True, mode="promise_in_bounds")
+            if cfg.has_categorical:
+                scat = scat.at[pair_sl].set(
+                    res2.is_cat, unique_indices=True,
+                    mode="promise_in_bounds")
+                scatb = scatb.at[pair_sl].set(
+                    res2.cat_bins, unique_indices=True,
+                    mode="promise_in_bounds")
+            cont = (new_leaf < L - 1) & (jnp.max(sgain) > 0.0)
+            state = (sgain, sf32, si32, scat, scatb, hist_store, feat_ok,
+                     tnf, tni, tlf, tli, tcat, tcatb)
+            return state, cont
+
+        def finalize(state, rl_blocks, num_leaves, n):
+            (_, _, _, _, _, _, _,
+             tnf, tni, tlf, tli, tcat, tcatb) = state
+            tree = unpack_tree(jnp.asarray(num_leaves, jnp.int32), tni,
+                               tnf, tlf, tli, tcat, tcatb, cfg)
+            row_leaf = jnp.concatenate(list(rl_blocks))[:n]
+            return tree, row_leaf
+
+        self._block_step = jax.jit(block_step)
+        self._root = jax.jit(root)
+        self._prep = jax.jit(prep)
+        self._apply_split = jax.jit(apply_split)
+        # n selects the [:n] trim statically — a static argnum, not a
+        # per-tree retrace (one dataset = one n)
+        self._finalize = jax.jit(finalize, static_argnums=(3,))
+        # reusable per-call constants (filled on first call)
+        self._rl_zero = None
+        self._acc_zero = None
+        self._root_args = None
+
+    def _cache_size(self) -> int:
+        """Total compilation count over the streamed jit pieces — what
+        the ``grower_jit_entries`` gauge reads (engine.py).  A chunk
+        loop that recompiles shows up here immediately."""
+        total = 0
+        for fn in (self._block_step, self._root, self._prep,
+                   self._apply_split, self._finalize):
+            cs = getattr(fn, "_cache_size", None)
+            if cs is not None:
+                total += int(cs())
+        return total
+
+    def hlo_census(self, streamer, meta: FeatureMeta, feat_valid,
+                   label: str = "grow"):
+        """Compiled-HLO collective census summed over the streamed jit
+        pieces at the training shapes — the single-device streamed
+        program must add ZERO collectives (tests pin the census empty).
+        After a training run the lowerings re-hit the jit cache, so this
+        is a read, not a second compile."""
+        from .obs.collectives import hlo_census as census
+        cfg = self.cfg
+        store = streamer.store
+        chunk, ncols = store.chunk_rows, store.num_cols
+        # committed like the training inputs, so these lowerings HIT the
+        # training's cache entries instead of adding placement variants
+        dev = streamer.device
+        zr = jax.device_put(jnp.zeros((store.padded_rows,), jnp.float32),
+                            dev)
+        blk = jax.device_put(jnp.zeros((chunk, ncols), store.dtype), dev)
+        rl = jax.device_put(jnp.zeros((chunk,), jnp.int32), dev)
+        acc = jax.device_put(
+            jnp.zeros((ncols, cfg.max_bin, 3), jnp.float32), dev)
+        state, _ = self._root(acc, zr, zr, zr, meta, feat_valid)
+        params = self._prep(state[0], state[1], state[2], state[3],
+                            state[4], 0)
+        lowered = (
+            self._block_step.lower(blk, rl, zr, zr, zr, 0, meta, *params,
+                                   chunk, acc),
+            self._root.lower(acc, zr, zr, zr, meta, feat_valid),
+            self._prep.lower(state[0], state[1], state[2], state[3],
+                             state[4], 0),
+            self._apply_split.lower(state, acc, 0, meta, feat_valid),
+            self._finalize.lower(state, (rl,) * store.num_blocks, 1,
+                                 store.num_rows),
+        )
+        out = {}
+        for lw in lowered:
+            for op, rec in census(lw.compile(), label=label).items():
+                cur = out.setdefault(op, {"count": 0, "bytes": 0,
+                                          "max_bytes": 0})
+                cur["count"] += rec["count"]
+                cur["bytes"] += rec["bytes"]
+                cur["max_bytes"] = max(cur["max_bytes"], rec["max_bytes"])
+        return out
+
+    def __call__(self, streamer, gw, hw, cw, meta: FeatureMeta,
+                 feat_valid):
+        cfg = self.cfg
+        L = cfg.num_leaves
+        store = streamer.store
+        n = store.num_rows
+        chunk = store.chunk_rows
+        np_rows = store.padded_rows
+        pad = np_rows - n
+        # every _block_step input is COMMITTED to the pipeline's device:
+        # the jit cache keys on argument placement, so mixing committed
+        # blocks with uncommitted zero constants / weight vectors forks
+        # the compilation per combination — the zero-recompile pin
+        # (grower_jit_entries) demands one stable signature
+        dev = streamer.device
+        if pad:
+            gp = jnp.pad(gw, (0, pad))
+            hp = jnp.pad(hw, (0, pad))
+            cp = jnp.pad(cw, (0, pad))
+        else:
+            gp, hp, cp = gw, hw, cw
+        gp, hp, cp = (jax.device_put(v, dev) for v in (gp, hp, cp))
+        if self._rl_zero is None or self._rl_zero.shape[0] != chunk:
+            self._rl_zero = jax.device_put(jnp.zeros((chunk,), jnp.int32),
+                                           dev)
+        if self._acc_zero is None \
+                or self._acc_zero.shape[0] != store.num_cols:
+            self._acc_zero = jax.device_put(
+                jnp.zeros((store.num_cols, cfg.max_bin, 3), gw.dtype), dev)
+        if self._root_args is None:
+            # root-pass split params as committed device scalars so the
+            # root and split passes share ONE block_step compilation
+            # (Python ints would trace weakly-typed and fork the cache)
+            self._root_args = jax.device_put(
+                (jnp.asarray(-1, jnp.int32),      # l: matches no row
+                 jnp.asarray(0, jnp.int32),       # new_leaf
+                 jnp.asarray(0, jnp.int32),       # feat
+                 jnp.asarray(0, jnp.int32),       # thr
+                 jnp.asarray(False),              # dleft
+                 jnp.asarray(False),              # cat_is
+                 jnp.zeros((cfg.max_bin,), bool),  # cat_row
+                 jnp.asarray(0, jnp.int32)), dev)  # small_id
+
+        def pass_blocks(rl, params):
+            """One full pass over the pipeline: route + accumulate the
+            pending split's smaller-child histogram across all blocks
+            in fixed block order (summation-order discipline)."""
+            l, new_leaf, feat, thr, dleft, cat_is, cat_row, sid = params
+            acc = self._acc_zero
+            for k, dev_blk, valid in streamer.blocks():
+                rl[k], acc = self._block_step(
+                    dev_blk, rl[k], gp, hp, cp, k * chunk, meta,
+                    l, new_leaf, feat, thr, dleft, cat_is, cat_row,
+                    sid, valid, acc)
+            return acc
+
+        rl = [self._rl_zero] * store.num_blocks
+        hist_root = pass_blocks(rl, self._root_args)
+        state, cont = self._root(hist_root, gp, hp, cp, meta, feat_valid)
+        step = 0
+        # ONE host scalar read per split — the streamed analogue of the
+        # resident while_loop's traced cond
+        while step < L - 1 and bool(jax.device_get(cont)):
+            params = self._prep(state[0], state[1], state[2], state[3],
+                                state[4], step)
+            hist_small = pass_blocks(rl, params)
+            state, cont = self._apply_split(state, hist_small, step,
+                                            meta, feat_valid)
+            step += 1
+        return self._finalize(state, tuple(rl), step + 1, n)

@@ -78,25 +78,26 @@ def dataset_from_file(filename, params, reference):
                    params=_parse_params(params))
 
 
-def _csr_to_dense(mv_indptr, nindptr, mv_indices, mv_data, nelem, ncol):
-    """Densify CSR rows — the framework's storage IS dense binned columns
-    (SURVEY §7: TPUs have no fast gather/scatter; EFB re-compresses
-    mutually-exclusive sparse columns at construct)."""
+def _csr_matrix(mv_indptr, nindptr, mv_indices, mv_data, nelem, ncol):
+    """Copied CSR triplet as a host :class:`~..data.sparse.CsrMatrix`.
+
+    The framework's storage IS dense binned columns (SURVEY §7: TPUs
+    have no fast gather/scatter; EFB re-compresses mutually-exclusive
+    sparse columns at construct), but densification happens one
+    budget-bounded row chunk at a time (data/sparse.py) — the full
+    ``[nrow, ncol]`` float64 matrix never materializes on ingest."""
+    from ..data.sparse import CsrMatrix
     indptr = np.frombuffer(mv_indptr, dtype=np.int32, count=nindptr)
     indices = np.frombuffer(mv_indices, dtype=np.int32, count=nelem)
     data = np.frombuffer(mv_data, dtype=np.float64, count=nelem)
-    nrow = nindptr - 1
-    X = np.zeros((nrow, ncol), dtype=np.float64)
-    row_of = np.repeat(np.arange(nrow), np.diff(indptr).astype(np.int64))
-    X[row_of, indices] = data
-    return X
+    return CsrMatrix(indptr, indices, data, ncol)
 
 
 def dataset_from_csr(mv_indptr, nindptr, mv_indices, mv_data, nelem, ncol,
                      params, reference):
     from ..basic import Dataset
-    X = _csr_to_dense(mv_indptr, nindptr, mv_indices, mv_data, nelem, ncol)
-    return Dataset(X, reference=reference, params=_parse_params(params))
+    csr = _csr_matrix(mv_indptr, nindptr, mv_indices, mv_data, nelem, ncol)
+    return Dataset(csr, reference=reference, params=_parse_params(params))
 
 
 def dataset_from_csc(mv_colptr, ncolptr, mv_indices, mv_data, nelem, nrow,
@@ -121,13 +122,20 @@ def dataset_empty(nrow, ncol, params, reference):
     return Dataset(X, reference=reference, params=_parse_params(params))
 
 
-def dataset_push_rows(ds, mv_data, nrow, ncol, start_row) -> bool:
+def _push_target(ds, nrow, ncol, start_row) -> np.ndarray:
+    """The preallocated dataset matrix a PushRows block lands in, with
+    the shared contract checks."""
     X = ds.data
     if ds._constructed is not None or not isinstance(X, np.ndarray):
         raise RuntimeError("PushRows on an already-constructed dataset")
     if ncol != X.shape[1] or start_row + nrow > X.shape[0]:
         raise ValueError(f"push block [{start_row}:{start_row + nrow}) x "
                          f"{ncol} outside dataset {X.shape}")
+    return X
+
+
+def dataset_push_rows(ds, mv_data, nrow, ncol, start_row) -> bool:
+    X = _push_target(ds, nrow, ncol, start_row)
     X[start_row:start_row + nrow] = np.frombuffer(
         mv_data, dtype=np.float64, count=nrow * ncol).reshape(nrow, ncol)
     return True
@@ -135,10 +143,13 @@ def dataset_push_rows(ds, mv_data, nrow, ncol, start_row) -> bool:
 
 def dataset_push_rows_csr(ds, mv_indptr, nindptr, mv_indices, mv_data,
                           nelem, ncol, start_row) -> bool:
-    block = _csr_to_dense(mv_indptr, nindptr, mv_indices, mv_data, nelem,
-                          ncol)
-    return dataset_push_rows(ds, memoryview(block).cast("B"),
-                             block.shape[0], ncol, start_row)
+    csr = _csr_matrix(mv_indptr, nindptr, mv_indices, mv_data, nelem, ncol)
+    X = _push_target(ds, csr.nrow, ncol, start_row)
+    # budget-bounded chunks write straight into the preallocated rows —
+    # no full dense copy of the pushed block ever exists
+    for r0, block in csr.iter_dense_chunks():
+        X[start_row + r0:start_row + r0 + len(block)] = block
+    return True
 
 
 def dataset_set_field(ds, name, mv_data, num_el, dtype_code) -> bool:
@@ -423,15 +434,20 @@ def booster_predict_full_into(bst, mv_in, nrow, ncol, predict_type,
 def booster_predict_csr_into(bst, mv_indptr, nindptr, mv_indices, mv_data,
                              nelem, ncol, predict_type, num_iteration,
                              mv_out, out_capacity) -> int:
-    X = _csr_to_dense(mv_indptr, nindptr, mv_indices, mv_data, nelem, ncol)
-    pred = _predict_array(bst, X, predict_type, num_iteration)
-    flat = pred.reshape(-1)
-    if flat.size > out_capacity:
-        raise ValueError(f"output buffer too small: need {flat.size}, "
-                         f"have {out_capacity}")
-    out = np.frombuffer(mv_out, dtype=np.float64, count=flat.size)
-    out[:] = flat
-    return int(flat.size)
+    csr = _csr_matrix(mv_indptr, nindptr, mv_indices, mv_data, nelem, ncol)
+    out = np.frombuffer(mv_out, dtype=np.float64, count=out_capacity)
+    wrote = 0
+    # predict one budget-bounded dense chunk at a time; per-row output
+    # width is fixed, so chunk outputs concatenate contiguously
+    for r0, block in csr.iter_dense_chunks():
+        flat = _predict_array(bst, block, predict_type,
+                              num_iteration).reshape(-1)
+        if wrote + flat.size > out_capacity:
+            raise ValueError(f"output buffer too small: need at least "
+                             f"{wrote + flat.size}, have {out_capacity}")
+        out[wrote:wrote + flat.size] = flat
+        wrote += flat.size
+    return int(wrote)
 
 
 def booster_predict_csc_into(bst, mv_colptr, ncolptr, mv_indices, mv_data,

@@ -329,7 +329,8 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
                 serving_buckets: Sequence[int] = (),
                 data_shards: int = 1, feature_shards: int = 1,
                 block_shard_bins: bool = False,
-                gspmd_fused: bool = False) -> Dict[str, Any]:
+                gspmd_fused: bool = False,
+                stream_chunk_rows: int = 0) -> Dict[str, Any]:
     """Analytic device-memory model of one training (the codified
     ``docs/MEMORY.md`` audit; that doc's table is generated from this
     function by ``scripts/gen_memory_doc.py``).
@@ -353,6 +354,13 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
     planner evaluates it per candidate mesh shape and picks one whose
     per-device peak fits the chip.  Defaults (1, 1) reproduce the
     single-device model unchanged.
+
+    ``stream_chunk_rows`` > 0 models the STREAMED single-device mode
+    (``data_stream=chunked``; data/stream.py): the binned matrix stays
+    host-side, so its resident term vanishes and is replaced by the
+    double-buffered pair of static-shape row blocks, the per-block
+    row->leaf routing vectors, and the carried histogram pool — the terms
+    that make HBM a function of the CHUNK size instead of the row count.
     """
     rows = int(rows)
     features = int(features)
@@ -424,6 +432,23 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
                 "row_leaf": 3 * rows_d * 4,
                 "hist_store": pool_bytes,
             }
+    elif stream_chunk_rows and int(stream_chunk_rows) > 0:
+        # streamed out-of-core mode (data_stream=chunked; data/stream.py
+        # + grower.StreamedGrower): the binned matrix never becomes
+        # device-resident — the device holds the DOUBLE-BUFFERED pair of
+        # static-shape row blocks, the per-block row->leaf routing
+        # vectors (alive across the whole tree), and the carried
+        # histogram pool; the per-split workspace is the masked
+        # scatter-add over ONE block (segment indices i32 + broadcast
+        # (g, h, c) value rows), so it scales with the chunk, not N
+        chunk = min(int(stream_chunk_rows), rows_d)
+        residents["binned"] = 0
+        residents["stream_blocks"] = 2 * chunk * features * bin_bytes
+        residents["stream_row_leaf"] = rows_d * 4
+        residents["hist_pool"] = pool_bytes
+        transients = {
+            "stream_hist_scatter": chunk * features * 16,
+        }
     else:
         transients = {
             # sentinel-padded copy of the histogram inputs (hbins_pad +
@@ -465,7 +490,8 @@ def predict_hbm(rows: int, features: int, bins: int = 255, leaves: int = 31,
                    "gather_words": bool(gather_words),
                    "data_shards": d, "feature_shards": fs,
                    "block_shard_bins": bool(block_shard_bins),
-                   "gspmd_fused": bool(gspmd_fused)},
+                   "gspmd_fused": bool(gspmd_fused),
+                   "stream_chunk_rows": int(stream_chunk_rows)},
         "residents": residents,
         "transients": transients,
         "resident_bytes": resident_bytes,

@@ -228,6 +228,152 @@ def plan_mesh(n_devices: int, rows: int, features: int, bins: int = 255,
         f"raise hbm_budget")
 
 
+class PlacementPlan(NamedTuple):
+    """One data-placement decision (``resolve_placement``): where the
+    binned training matrix lives for this run and the evidence backing
+    the choice."""
+    mode: str                  # resident | chunked | sharded
+    chunk_rows: int            # streamed block size (0 unless chunked)
+    mesh: Optional[MeshPlan]   # the mesh plan when mode == "sharded"
+    peak_bytes: int            # predicted peak at the chosen placement
+    capacity: Optional[int]    # budget the plan was judged against
+    components: dict           # top predicted components {name: bytes}
+    reason: str                # human-readable decision trail
+
+
+def default_chunk_rows(rows: int, requested: int = 0) -> int:
+    """Streamed block size: the explicit ``stream_chunk_rows`` when
+    given (clamped to the row count), else 256k rows capped at
+    ``ceil(rows / 2)`` so even a small dataset exercises at least two
+    blocks — the double buffer is pointless with one."""
+    rows = max(1, int(rows))
+    if requested and int(requested) > 0:
+        return min(int(requested), rows)
+    return max(1, min(262144, -(-rows // 2)))
+
+
+def resolve_placement(rows: int, features: int, bins: int = 255,
+                      leaves: int = 31, num_class: int = 1,
+                      bin_bytes: Optional[int] = None,
+                      packed_cols: int = 0, valid_rows: int = 0,
+                      capacity: Optional[int] = None,
+                      data_stream: str = "auto",
+                      stream_chunk_rows: int = 0,
+                      n_devices: int = 1, prefer: str = "data",
+                      gspmd_fused: bool = False, procs: int = 1,
+                      local_devices: int = 0) -> PlacementPlan:
+    """The unified capacity walk (``data_stream=auto``): decide where the
+    binned matrix lives BEFORE anything compiles by evaluating
+    ``obs/memory.predict_hbm`` per placement rung —
+
+    1. **resident** — the classic whole-matrix-on-device layout;
+    2. **chunked** — streamed out-of-core blocks (data/stream.py): the
+       requested (or default) block size first, then halving blocks down
+       to a 4096-row floor, since the double-buffer footprint is the
+       planner's lever;
+    3. **sharded** — hand the shape to :func:`plan_mesh` when more than
+       one device is available.
+
+    An explicit ``data_stream=resident|chunked`` pins the rung (the
+    budget check still runs later in pre-flight, so a forced placement
+    that does not fit fails with the component breakdown rather than an
+    on-chip OOM).  Every decision lands as one structured
+    ``placement_decision`` obs event; when NOTHING fits the walk raises
+    :class:`MeshPlanError` naming the best candidate per rung."""
+    from ..obs.counters import counters
+    from ..obs.memory import predict_hbm
+
+    def predict(chunk):
+        p = predict_hbm(rows=rows, features=features, bins=bins,
+                        leaves=leaves, num_class=num_class,
+                        bin_bytes=bin_bytes, packed_cols=packed_cols,
+                        valid_rows=valid_rows, stream_chunk_rows=chunk)
+        comps = dict(sorted({**p["residents"], **p["transients"]}.items(),
+                            key=lambda kv: -kv[1])[:4])
+        return int(p["peak_bytes"]), comps
+
+    def decide(plan: PlacementPlan) -> PlacementPlan:
+        counters.event("placement_decision", mode=plan.mode,
+                       chunk_rows=plan.chunk_rows,
+                       predicted_peak_bytes=plan.peak_bytes,
+                       capacity_bytes=plan.capacity,
+                       data_stream=data_stream, reason=plan.reason)
+        return plan
+
+    res_peak, res_comps = predict(0)
+    if data_stream == "resident":
+        return decide(PlacementPlan(
+            "resident", 0, None, res_peak, capacity, res_comps,
+            "data_stream=resident pinned by config"))
+    if data_stream == "auto" and (capacity is None
+                                  or res_peak <= capacity):
+        why = ("resident: no capacity signal" if capacity is None else
+               f"resident: predicted peak {res_peak / 1e9:.2f} GB fits "
+               f"capacity {capacity / 1e9:.2f} GB")
+        return decide(PlacementPlan("resident", 0, None, res_peak,
+                                    capacity, res_comps, why))
+
+    chunk0 = default_chunk_rows(rows, stream_chunk_rows)
+    forced_chunk = data_stream == "chunked"
+    best_stream = None
+    chunk = chunk0
+    while True:
+        peak, comps = predict(chunk)
+        if best_stream is None or peak < best_stream[1]:
+            best_stream = (chunk, peak, comps)
+        if forced_chunk and stream_chunk_rows:
+            # an explicit block size is a pin, not a starting point
+            break
+        if capacity is not None and peak > capacity and chunk > 4096:
+            chunk = max(4096, chunk // 2)
+            continue
+        break
+    chunk, peak, comps = best_stream
+    if forced_chunk or capacity is None or peak <= capacity:
+        why = (f"chunked: {chunk}-row blocks, predicted peak "
+               f"{peak / 1e9:.2f} GB"
+               + (" pinned by data_stream=chunked" if forced_chunk else
+                  (f" fits capacity {capacity / 1e9:.2f} GB (resident "
+                   f"needs {res_peak / 1e9:.2f} GB)"
+                   if capacity is not None else "")))
+        return decide(PlacementPlan("chunked", chunk, None, peak,
+                                    capacity, comps, why))
+
+    if n_devices > 1:
+        try:
+            mp = plan_mesh(n_devices, rows, features, bins=bins,
+                           leaves=leaves, num_class=num_class,
+                           bin_bytes=bin_bytes, packed_cols=packed_cols,
+                           valid_rows=valid_rows, capacity=capacity,
+                           prefer=prefer, gspmd_fused=gspmd_fused,
+                           procs=procs, local_devices=local_devices)
+        except MeshPlanError:
+            mp = None
+        if mp is not None:
+            return decide(PlacementPlan(
+                "sharded", 0, mp, mp.per_device_bytes, capacity,
+                mp.components,
+                f"sharded: {mp.reason} (resident needs "
+                f"{res_peak / 1e9:.2f} GB, best streamed "
+                f"{peak / 1e9:.2f} GB)"))
+
+    detail = ", ".join(f"{k}={v / 1e9:.2f} GB" for k, v in comps.items())
+    counters.event("placement_decision", mode="refused",
+                   chunk_rows=chunk, predicted_peak_bytes=peak,
+                   capacity_bytes=capacity, data_stream=data_stream,
+                   reason="no placement fits")
+    raise MeshPlanError(
+        f"no data placement fits capacity "
+        f"{(capacity or 0) / 1e9:.2f} GB: resident needs "
+        f"{res_peak / 1e9:.2f} GB, best streamed candidate "
+        f"({chunk}-row blocks) still needs {peak / 1e9:.2f} GB "
+        f"(top components: {detail})"
+        + ("" if n_devices > 1 else ", and only 1 device is available "
+           "for sharding") +
+        " — shrink the shape (num_leaves/max_bin), lower "
+        "stream_chunk_rows, add devices, or raise hbm_budget")
+
+
 def parse_mesh_shape(spec: str, n_devices: int, prefer: str = "data"):
     """``mesh_shape`` parameter -> (data, feature) extents or None for
     ``auto`` (planner decides).  Accepts ``DxF`` (``2x4``), ``data``

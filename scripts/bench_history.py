@@ -36,6 +36,11 @@ Verdicts (entries are taken in the given CLI order = time order):
   traced different histogram kernels → FAIL (mislabeled series);
 * ``memory_peak_creep`` — the newest measured peak grew beyond
   ``--memory-pct`` over the median of its predecessors → FAIL;
+* ``stall_fraction_creep`` — within a streamed-rung identity
+  (``bench_streamed.json``), the chunked side's measured pipeline stall
+  fraction grew more than 0.15 absolute over the median of its
+  predecessors → FAIL (the double-buffered pipeline is hiding less of
+  the host→device copy);
 * ``device_profile_coverage`` — how many entries carry the devprof
   attribution block → info (the capture-backlog freshness view).
 
@@ -115,7 +120,8 @@ def normalize(raw, label):
     """One raw document -> the flat series entry the verdicts read."""
     entry = {"label": label, "probe_failed": False, "run_failed": False,
              "rc": 0, "value": None, "metric": None, "kernel": None,
-             "memory_peak": None, "device_profile": None}
+             "memory_peak": None, "device_profile": None,
+             "stall_fraction": None}
     if not isinstance(raw, dict):
         entry["run_failed"] = True
         return entry
@@ -147,6 +153,13 @@ def normalize(raw, label):
         entry["memory_peak"] = int(mp) if isinstance(mp, (int, float)) \
             and mp else None
         entry["device_profile"] = parsed.get("device_profile")
+        # streamed-rung artifacts (bench_streamed.json): the chunked
+        # side's measured pipeline stall fraction, tracked for creep
+        sf = (((parsed.get("streamed") or {}).get("configs") or {})
+              .get("chunked") or {}).get("stall_fraction")
+        entry["stall_fraction"] = (float(sf)
+                                   if isinstance(sf, (int, float))
+                                   else None)
     return entry
 
 
@@ -240,6 +253,23 @@ def verdicts(entries, drift_pct=15.0, memory_pct=25.0, streak_min=2):
                     f"median of {len(prev)} prior round(s) "
                     f"(threshold {memory_pct:g}%)",
                     rounds=[e["label"] for e in peaks]))
+        stalls = [e for e in group if e["stall_fraction"] is not None]
+        if len(stalls) >= 3:
+            # absolute creep on the [0,1] fraction: the pipeline's overlap
+            # regressing (transfers no longer hidden) is a FAIL even when
+            # trees/s noise masks it
+            *prev, last = stalls
+            med = statistics.median(e["stall_fraction"] for e in prev)
+            delta = last["stall_fraction"] - med
+            if delta > 0.15:
+                findings.append(_finding(
+                    "stall_fraction_creep", FAIL,
+                    f"{metric}: chunked stall fraction "
+                    f"{last['stall_fraction']:.3f} at {last['label']} is "
+                    f"{delta:+.3f} over the median "
+                    f"({med:.3f}) of {len(prev)} prior round(s) — the "
+                    "stream pipeline is hiding less of the copy",
+                    rounds=[e["label"] for e in stalls]))
     with_dp = [e["label"] for e in entries if e["device_profile"]]
     findings.append(_finding(
         "device_profile_coverage", INFO,

@@ -78,7 +78,8 @@ FLIPS = [
 COVERAGE = ["bench_1m_63bin.json", "bench_higgs_full.json",
             "bench_wide.json", "bench_sparse.json", "bench_leaves.json",
             "bench_leaves_fused.json", "bench_serving.json",
-            "bench_mesh.json", "bench_mesh_fused.json"]
+            "bench_mesh.json", "bench_mesh_fused.json",
+            "bench_streamed.json"]
 # scripts/obs_diff.py thresholds for the in-pair drift annotations (the
 # same defaults the CLI uses)
 _DIFF_THRESHOLDS = {"throughput_pct": 10.0, "latency_pct": 25.0,
@@ -270,6 +271,47 @@ def mesh_rows(d):
     return out
 
 
+def streamed_rows(d):
+    """Lines for the streamed rung A/B (bench.py BENCH_STREAMED=1): the
+    resident-vs-chunked throughput pair under the artificial hbm_budget,
+    the measured pipeline stall fraction, the chunk pipeline shape, and
+    the zero-recompile pin.  A host rung: the chunked/resident ratio and
+    stall fraction are the pipeline's overlap evidence (CPU's synchronous
+    dispatch makes both conservative — on-chip DMA hides more of the
+    copy); ``data_stream`` auto stays the default either way, the rung
+    exists so the streamed regime's cost is a tracked number."""
+    s = d.get("streamed")
+    if not isinstance(s, dict):
+        return []
+    out = []
+    parts = []
+    for name in ("resident", "chunked"):
+        rec = (s.get("configs") or {}).get(name)
+        if not isinstance(rec, dict):
+            continue
+        if "error" in rec:
+            parts.append(f"{name}=ERR")
+            continue
+        mode = (rec.get("placement") or {}).get("mode")
+        parts.append(f"{name}{f'[{mode}]' if mode else ''}="
+                     f"{rec.get('trees_per_sec')}")
+    ratio = (s.get("configs") or {}).get("chunked_vs_resident")
+    if ratio is not None:
+        parts.append(f"chunked_vs_resident={ratio}")
+    out.append(f"streamed[{s.get('rows')}x{s.get('features')}, budget "
+               f"{s.get('hbm_budget')}B]: " + ", ".join(parts))
+    ch = (s.get("configs") or {}).get("chunked") or {}
+    if "stall_fraction" in ch:
+        out.append(f"  chunk pipeline: {ch.get('blocks')} x "
+                   f"{ch.get('chunk_rows')} rows, stall fraction "
+                   f"{ch['stall_fraction']} "
+                   f"({ch.get('stream_wait_ms_per_tree')} ms wait/tree, "
+                   f"{ch.get('stalls')} stalls), jit entries "
+                   f"{ch.get('grower_jit_entries')}"
+                   f"{' ZERO-RECOMPILE' if ch.get('zero_recompile') else ' RECOMPILED'}")
+    return out
+
+
 def probe_failed_row(d):
     """Render a structured probe_failed artifact (a stage that timed out
     or died mid-tunnel; tpu_capture_phase2.sh fail_artifact / the
@@ -349,6 +391,8 @@ def main():
             if dr:
                 print(f"{'':53}{dr}")
             for line in mesh_rows(d):
+                print(f"{'':53}{line}")
+            for line in streamed_rows(d):
                 print(f"{'':53}{line}")
     for fname, knob, action, base_name in FLIPS:
         d = load(os.path.join(cap, fname))

@@ -262,6 +262,21 @@ cat "$OUT/bench_mesh_fused.json" | tee -a "$OUT/log.txt"
 snap "mesh fused A/B"
 
 alive_or_abort "mesh fused A/B"
+echo "== streamed rung (resident-vs-chunked out-of-core pipeline A/B) ==" \
+    | tee -a "$OUT/log.txt"
+# the double-buffered host->device block pipeline under an artificial
+# hbm_budget (data/stream.py): trees/s + rows/s per side, the measured
+# stall fraction (how much copy the compute did NOT hide), and the
+# grower_jit_entries zero-recompile pin over the chunk loop.  A host
+# rung by construction — CPU's synchronous dispatch upper-bounds the
+# stall fraction; cheap even mid-tunnel since it never touches the TPU
+BENCH_STREAMED=1 BENCH_STAGE_TIMEOUT=1800 timeout -k 30 2100 \
+    python bench.py > "$OUT/bench_streamed.json" 2>> "$OUT/log.txt" \
+    || fail_artifact "streamed" $? "$OUT/bench_streamed.json"
+cat "$OUT/bench_streamed.json" | tee -a "$OUT/log.txt"
+snap "streamed rung"
+
+alive_or_abort "streamed rung"
 echo "== ordered_bins + sort partition A/B (no gathers, no scatters) ==" \
     | tee -a "$OUT/log.txt"
 BENCH_TRACE="$OUT/trace_1m_ordered_sort.jsonl" \

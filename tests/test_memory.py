@@ -247,6 +247,10 @@ def test_every_downgrade_warning_also_emits_a_layout_event():
              + glob.glob(os.path.join(ROOT, "lightgbm_tpu", "ops", "*.py"))
              + glob.glob(os.path.join(ROOT, "lightgbm_tpu", "data", "*.py"))
              + glob.glob(os.path.join(ROOT, "lightgbm_tpu", "parallel",
+                                      "*.py"))
+             + glob.glob(os.path.join(ROOT, "lightgbm_tpu", "native",
+                                      "*.py"))
+             + glob.glob(os.path.join(ROOT, "lightgbm_tpu", "obs",
                                       "*.py")))
     missing = []
     checked = 0

@@ -165,6 +165,255 @@ def test_binary_cache_preserves_bundles(tmp_path):
     assert m2 == m1
 
 
+# ---------------------- streamed out-of-core execution (data/stream.py)
+
+def _grower_fixture():
+    import jax.numpy as jnp
+    from lightgbm_tpu.grower import FeatureMeta, GrowerConfig
+    N, F, B, L = 4096, 8, 32, 15
+    cfg = GrowerConfig(num_leaves=L, min_data_in_leaf=1, max_bin=B,
+                      hist_method="segment", has_missing=False)
+    meta = FeatureMeta(
+        num_bin=jnp.full((F,), B, jnp.int32),
+        missing_type=jnp.zeros((F,), jnp.int32),
+        default_bin=jnp.zeros((F,), jnp.int32),
+        is_categorical=jnp.zeros((F,), bool))
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, B, size=(N, F)).astype(np.uint8)
+    # integer-valued gradients: every summation order is exact in f32,
+    # so block-ordered accumulation must be BYTE-identical to resident
+    g = rng.randint(-8, 9, size=N).astype(np.float32)
+    h = rng.randint(1, 9, size=N).astype(np.float32)
+    c = np.ones(N, np.float32)
+    fv = jnp.ones((F,), bool)
+    return cfg, meta, bins, g, h, c, fv
+
+
+def test_streamed_grower_byte_identity_and_recompile_pin():
+    """The tentpole invariant: block-accumulated histogram growth over
+    the double-buffered chunk pipeline produces byte-identical trees to
+    the resident single-pass grower — at 1 block, 2 blocks, and N blocks
+    with a short final block — and repeated trees add ZERO jit cache
+    entries (all block shapes pad to one static shape)."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.grower import StreamedGrower, make_grower
+    from lightgbm_tpu.data.stream import BlockStreamer, HostBlockStore
+    cfg, meta, bins, g, h, c, fv = _grower_fixture()
+    N = len(bins)
+    grow = jax.jit(make_grower(cfg))
+    ref_tree, ref_rl = grow(jnp.asarray(bins), jnp.asarray(g),
+                            jnp.asarray(h), jnp.asarray(c), meta, fv)
+    ref_tree = jax.tree.map(np.asarray, ref_tree)
+    ref_rl = np.asarray(ref_rl)
+    assert int(ref_tree.num_leaves) > 1
+
+    for chunk in (N, N // 2, 1000):   # 1, 2, and 5 blocks w/ short tail
+        sg = StreamedGrower(cfg)
+        streamer = BlockStreamer(HostBlockStore(bins, chunk))
+        st_tree, st_rl = sg(streamer, jnp.asarray(g), jnp.asarray(h),
+                            jnp.asarray(c), meta, fv)
+        st_tree = jax.tree.map(np.asarray, st_tree)
+        for f in ref_tree._fields:
+            np.testing.assert_array_equal(
+                getattr(ref_tree, f), getattr(st_tree, f),
+                err_msg=f"chunk={chunk} field={f}")
+        np.testing.assert_array_equal(ref_rl, np.asarray(st_rl))
+        n0 = sg._cache_size()
+        for _ in range(2):            # repeated trees must not recompile
+            sg(streamer, jnp.asarray(g), jnp.asarray(h), jnp.asarray(c),
+               meta, fv)
+        assert sg._cache_size() == n0, (
+            f"chunk loop recompiled: {n0} -> {sg._cache_size()} jit "
+            f"entries at chunk={chunk}")
+
+
+# ----------------------- pre-flight placement walk (resolve_placement)
+
+def _events(name):
+    from lightgbm_tpu.obs.counters import counters
+    return [e for e in counters.events() if e["event"] == name]
+
+
+def test_resolve_placement_resident_rungs():
+    from lightgbm_tpu.obs.counters import counters
+    from lightgbm_tpu.parallel.mesh import resolve_placement
+    counters.reset()
+    # no capacity signal -> resident, no second-guessing
+    p = resolve_placement(200000, 30, bins=63, leaves=31)
+    assert (p.mode, p.chunk_rows) == ("resident", 0)
+    # generous capacity -> resident fits
+    p2 = resolve_placement(200000, 30, bins=63, leaves=31,
+                           capacity=p.peak_bytes * 10)
+    assert p2.mode == "resident" and p2.peak_bytes <= p2.capacity
+    # explicit pin ignores an impossible budget (pre-flight re-checks)
+    p3 = resolve_placement(200000, 30, bins=63, leaves=31,
+                           data_stream="resident", capacity=10)
+    assert p3.mode == "resident"
+    evs = _events("placement_decision")
+    assert len(evs) == 3 and {e["mode"] for e in evs} == {"resident"}
+
+
+def test_resolve_placement_walks_to_chunked():
+    from lightgbm_tpu.obs.memory import predict_hbm
+    from lightgbm_tpu.parallel.mesh import resolve_placement
+    rows, feats = 200000, 30
+    res = predict_hbm(rows=rows, features=feats, bins=63, leaves=31)
+    floor = predict_hbm(rows=rows, features=feats, bins=63, leaves=31,
+                        stream_chunk_rows=4096)
+    cap = (res["peak_bytes"] + floor["peak_bytes"]) // 2
+    p = resolve_placement(rows, feats, bins=63, leaves=31, capacity=cap)
+    assert p.mode == "chunked" and p.chunk_rows > 0
+    assert p.peak_bytes <= cap < res["peak_bytes"]
+    # an explicit stream_chunk_rows is a pin, not a starting point
+    p2 = resolve_placement(rows, feats, bins=63, leaves=31,
+                           data_stream="chunked", stream_chunk_rows=7777)
+    assert (p2.mode, p2.chunk_rows) == ("chunked", 7777)
+
+
+def test_resolve_placement_sharded_and_refusal():
+    from lightgbm_tpu.obs.counters import counters
+    from lightgbm_tpu.obs.memory import predict_hbm
+    from lightgbm_tpu.parallel.mesh import MeshPlanError, resolve_placement
+    # narrow matrix: per-row residents dominate, so sharding /8 beats the
+    # streamed floor -> capacity between them lands on the sharded rung
+    rows, feats = 2_000_000, 4
+    floor = predict_hbm(rows=rows, features=feats, bins=63, leaves=31,
+                        stream_chunk_rows=4096)
+    cap = floor["peak_bytes"] - 1
+    p = resolve_placement(rows, feats, bins=63, leaves=31, capacity=cap,
+                          n_devices=8)
+    assert p.mode == "sharded" and p.mesh is not None
+    assert p.peak_bytes <= cap
+    # same squeeze with a single device: structured refusal BEFORE any
+    # compile, naming the best candidate per rung
+    counters.reset()
+    with pytest.raises(MeshPlanError) as ei:
+        resolve_placement(rows, feats, bins=63, leaves=31, capacity=cap)
+    msg = str(ei.value)
+    assert "no data placement fits" in msg
+    assert "only 1 device is available" in msg
+    refusals = [e for e in _events("placement_decision")
+                if e["mode"] == "refused"]
+    assert len(refusals) == 1
+
+
+# --------------------------- end-to-end streamed training (engine path)
+
+def test_streamed_train_matches_resident_no_collectives():
+    """data_stream=chunked through lgb.train: the chunk pipeline runs
+    inside the normal boosting loop, predictions match resident to float
+    round-off, the HLO census stays collective-free single-process, and
+    the streamer's wait accounting lands in the obs counters."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.counters import counters
+    rng = np.random.RandomState(7)
+    N, F = 5000, 10
+    X = rng.randn(N, F)
+    y = X[:, 0] + 0.5 * X[:, 1] ** 2 + rng.randn(N) * 0.1
+    base = {"objective": "regression", "verbose": -1, "num_leaves": 15,
+            "min_data_in_leaf": 5}
+    bst_res = lgb.train(dict(base), lgb.Dataset(X, label=y),
+                        num_boost_round=8, verbose_eval=False)
+    pred_res = bst_res.predict(X)
+
+    counters.reset()
+    bst_str = lgb.train(dict(base, data_stream="chunked",
+                             stream_chunk_rows=1500),
+                        lgb.Dataset(X, label=y), num_boost_round=8,
+                        verbose_eval=False)
+    pred_str = bst_str.predict(X)
+    np.testing.assert_allclose(pred_str, pred_res, atol=1e-4)
+    evs = _events("placement_decision")
+    assert len(evs) == 1 and evs[0]["mode"] == "chunked"
+    assert evs[0]["chunk_rows"] == 1500
+    # streaming must not introduce cross-device traffic single-process
+    assert bst_str.inner.grow_hlo_census() == {}
+    assert counters.total("stream_wait_ms") >= 0.0
+    # the streamed grower splits into a fixed handful of jit pieces and
+    # stays there for the whole 8-round run
+    assert bst_str.inner.grow._cache_size() == 5
+
+
+# ------------------------------- CSR ingest (data/sparse.py, no densify)
+
+def _random_csr(n, f, density, seed):
+    from lightgbm_tpu.data.sparse import CsrMatrix
+    rng = np.random.RandomState(seed)
+    X = np.where(rng.rand(n, f) < density, rng.randn(n, f), 0.0)
+    indptr = np.zeros(n + 1, np.int64)
+    indices, data = [], []
+    for i in range(n):
+        nz = np.flatnonzero(X[i])
+        indptr[i + 1] = indptr[i] + len(nz)
+        indices.append(nz)
+        data.append(X[i, nz])
+    csr = CsrMatrix(indptr, np.concatenate(indices).astype(np.int64),
+                    np.concatenate(data), f)
+    return X, csr
+
+
+def test_csr_chunked_binning_is_budget_bounded_and_identical(monkeypatch):
+    """Non-densifying CSR ingest: with the chunk budget squeezed to a
+    few rows, every dense block stays under budget, the chunk count is
+    exact, and the binned matrix is byte-identical to the dense path
+    (same sample indices by construction)."""
+    from lightgbm_tpu.data import sparse
+    from lightgbm_tpu.data.dataset import construct, construct_csr
+    n, f = 2017, 9                       # odd count -> short final chunk
+    X, csr = _random_csr(n, f, 0.3, 11)
+    np.testing.assert_array_equal(np.asarray(csr), X)
+
+    budget = 32 * f * 8                  # 32 dense rows per chunk
+    monkeypatch.setattr(sparse, "CSR_CHUNK_BUDGET_BYTES", budget)
+    assert sparse.csr_chunk_rows(f) == 32
+    nchunks, peak = 0, 0
+    rows_seen = 0
+    for r0, block in csr.iter_dense_chunks():
+        assert r0 == rows_seen
+        rows_seen += len(block)
+        nchunks += 1
+        peak = max(peak, block.nbytes)
+    assert rows_seen == n
+    assert nchunks == -(-n // 32)
+    assert peak <= budget
+
+    cfg = config_from_params({"max_bin": 63, "verbose": -1,
+                              "bin_construct_sample_cnt": 500})
+    y = (X.sum(1) > 0).astype(np.float32)
+    ref = construct(X, cfg, label=y)
+    got = construct_csr(csr, cfg, label=y)
+    infos_r = [m.feature_info_str() for m in ref.bin_mappers]
+    infos_c = [m.feature_info_str() for m in got.bin_mappers]
+    assert infos_r == infos_c
+    np.testing.assert_array_equal(got.binned, ref.binned)
+
+
+def test_csr_dataset_never_densifies_during_construct(monkeypatch):
+    """A Dataset over a CsrMatrix must bin through the chunked two-round
+    path: full densification (``__array__``) is off-limits until a legacy
+    consumer explicitly asks via ensure_raw.  Trained models are
+    identical to the dense-matrix path."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.data import sparse
+    X, csr = _random_csr(1500, 8, 0.4, 3)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    params = dict(objective="binary", num_leaves=7, min_data_in_leaf=10,
+                  verbose=-1)
+
+    def boom(self, dtype=None, copy=None):
+        raise AssertionError("CSR construct densified the full matrix")
+    monkeypatch.setattr(sparse.CsrMatrix, "__array__", boom)
+    d = lgb.Dataset(csr, label=y, params=params)
+    bst_csr = lgb.train(params, d, num_boost_round=5)
+    m_csr = bst_csr.model_to_string()
+    monkeypatch.undo()
+
+    bst_dense = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                          num_boost_round=5)
+    assert m_csr == bst_dense.model_to_string()
+
+
 def test_binary_cache_user_fields_override(tmp_path):
     """User-supplied label/weight/group/init_score must override the
     cached metadata when a dataset is loaded from the '<data>.bin'
