@@ -61,6 +61,9 @@ degraded + kernel_mismatch so decide_flips.py refuses to compare it.
 "metrics_snapshot" embeds the live Prometheus sample map
 (obs/metrics.snapshot) next to "telemetry"/"memory" so
 scripts/obs_diff.py can regression-diff two rungs at the metrics level.
+"model_quality" embeds the obs/model_quality tracker summary of the
+measured training (top features by cumulative gain, gain-decay curve) so
+bench_history.py can warn on an importance flip between same-config runs.
 BENCH_TRACE=<path> additionally writes a Chrome-trace span file for the
 measured child (render: `python -m lightgbm_tpu.obs <path>`).
 
@@ -725,6 +728,12 @@ def child_main():
     if bench_trace or devprof_armed:
         obs_trace.start(bench_trace or None)
     obs_memory.start()
+    # model-quality plane: every bench JSON embeds the tracker summary
+    # (top features by cumulative gain, gain-decay curve) so
+    # bench_history.py can flag an importance flip between runs at the
+    # same config.  Host-side folds over the drain's fetched arrays only.
+    from lightgbm_tpu.obs import model_quality as obs_model_quality
+    obs_model_quality.start()
     if devprof_armed:
         obs_devprof.start(profile_iters=profile_iters)
     # a skipped TPU (probe failure in the parent) is first-class evidence:
@@ -804,6 +813,12 @@ def child_main():
     # leaves-sweep micro-rung trains its extra (possibly chain-forced A/B)
     # boosters into the same counter registry
     split_find_counts = obs_counters.get("split_find_dispatch")
+
+    # model-quality summary of the MEASURED training, snapshotted (and
+    # the tracker disarmed) BEFORE the micro-rungs train extra boosters
+    _ = booster.models               # drain the async tail into the tracker
+    model_quality = obs_model_quality.get_tracker().summary()
+    obs_model_quality.stop()
 
     # device-time attribution block, finalized BEFORE the micro-rungs so
     # it describes the measured training only (obs/devprof.py)
@@ -922,6 +937,7 @@ def child_main():
         "telemetry": telemetry,
         "memory": memory_block,
         "metrics_snapshot": metrics_snapshot,
+        "model_quality": model_quality,
     }
     if device_profile is not None:
         result["device_profile"] = device_profile

@@ -13,8 +13,8 @@ from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .engine import cv, train
-from .plotting import (create_tree_digraph, plot_importance, plot_metric,
-                       plot_tree)
+from .plotting import (create_tree_digraph, plot_contrib_summary,
+                       plot_importance, plot_metric, plot_tree)
 
 __version__ = "0.1.0"
 
@@ -23,6 +23,7 @@ __all__ = [
     "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "NonFiniteError",
     "plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",
+    "plot_contrib_summary",
 ]
 
 try:  # sklearn API is optional at import time

@@ -836,7 +836,8 @@ class Booster:
     # -- prediction / io ----------------------------------------------------
 
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
-                pred_leaf: bool = False, pred_early_stop: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                pred_early_stop: bool = False,
                 pred_parameter: Optional[Dict[str, Any]] = None, **kwargs):
         if isinstance(data, (str, os.PathLike)):
             feats, _, _ = load_text_file(str(data),
@@ -853,12 +854,14 @@ class Booster:
         pp = canonicalize_params(pred_parameter or {})
         pred_early_stop = bool(pp.get("pred_early_stop", pred_early_stop))
         pred_leaf = bool(pp.get("is_predict_leaf_index", pred_leaf))
+        pred_contrib = bool(pp.get("is_predict_contrib", pred_contrib))
         raw_score = bool(pp.get("is_predict_raw_score", raw_score))
         es_freq = pp.get("pred_early_stop_freq")
         es_margin = pp.get("pred_early_stop_margin")
         return self.inner.predict(
             data, num_iteration=num_iteration, raw_score=raw_score,
-            pred_leaf=pred_leaf, pred_early_stop=pred_early_stop,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+            pred_early_stop=pred_early_stop,
             pred_early_stop_freq=None if es_freq is None else int(es_freq),
             pred_early_stop_margin=(None if es_margin is None
                                     else float(es_margin)))

@@ -35,6 +35,7 @@ from .obs import devprof as obs_devprof
 from .obs import flight as obs_flight
 from .obs import memory as obs_memory
 from .obs import metrics as obs_metrics
+from .obs import model_quality as obs_model_quality
 from .obs import trace as obs_trace
 from .obs.counters import counters as obs_counters
 from .ops.histogram import on_tpu
@@ -108,6 +109,10 @@ class GBDT:
         self._native_pred = None
         self._pred_engine = None
         self._pred_engine_ntrees = -1
+        # training-set bin distribution for the serving drift monitor
+        # (obs/model_quality.py): computed lazily at save when the plane
+        # is armed, or parsed back from a loaded model file
+        self.feature_distribution = None
         self.models: List[Tree] = []
         self.timers = PhaseTimers()   # TIMETAG analogue (gbdt.cpp:22-64)
         self.iter_ = 0
@@ -173,6 +178,11 @@ class GBDT:
                 self._revert_tree_scores(rec["k"], tree)
                 continue
             self._models.append(tree)
+            # split audit (obs/model_quality.py): fold the freshly
+            # materialized host arrays — data this drain fetched anyway,
+            # so the armed plane adds zero device syncs (pinned)
+            obs_model_quality.get_tracker().observe_tree(
+                int(rec["iter"]), len(self._models) - 1, tree)
             if tree.num_leaves > 1:
                 self._iter_had_split = True
             if rec["k"] == self.num_class - 1:
@@ -1226,6 +1236,12 @@ class GBDT:
                 rec["stream_wait_ms"] = round(wait, 3)
                 rec["stream_stall_fraction"] = round(
                     min(1.0, wait / (dt * 1e3)), 4)
+            # per-metric eval values (model-quality plane): the engine
+            # evaluates AFTER update, so the freshest stashed values are
+            # the previous iteration's — stamped as such
+            evals = obs_model_quality.get_tracker().eval_fields()
+            if evals:
+                rec["eval"] = evals
             fl.progress(int(self.iter_), **rec)
         return stop
 
@@ -1355,6 +1371,10 @@ class GBDT:
                         self.train_set.bin_mappers, self._num_bin_host)
                     tree.shrink(lr)
                     self._models.append(tree)
+                    # split audit over the arrays this sync path already
+                    # fetched — zero added device traffic (pinned)
+                    obs_model_quality.get_tracker().observe_tree(
+                        int(self.iter_), len(self._models) - 1, tree)
             # pipelined: the split/no-split outcome is unknown on host, but
             # a no-split tree's leaf_value is all zeros so the score update
             # is a provable no-op — dispatch it unconditionally
@@ -1722,10 +1742,15 @@ class GBDT:
 
     def _eval_inner(self, name, metrics, scores) -> List[Tuple[str, str, float, bool]]:
         results = []
+        mq = obs_model_quality.get_tracker()
         for m in metrics:
             vals = m.eval(scores, self.objective)
             for mn, v in zip(m.names(), vals):
                 results.append((name, mn, float(v), m.is_higher_better))
+                # stash for the NEXT progress record (the engine loop
+                # evaluates after update, so the flight stream carries
+                # each iteration's evals one record late)
+                mq.note_eval(name, mn, float(v))
         return results
 
     # ---------------------------------------------------------------- predict
@@ -1785,9 +1810,15 @@ class GBDT:
                              else self.config.pred_early_stop_margin))
 
     def predict(self, X, num_iteration: int = -1, raw_score: bool = False,
-                pred_leaf: bool = False, pred_early_stop: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                pred_early_stop: bool = False,
                 pred_early_stop_freq: Optional[int] = None,
                 pred_early_stop_margin: Optional[float] = None):
+        if pred_contrib:
+            # TreeSHAP path attribution — routed around the native
+            # short-circuit (the C++ predictor is margin-only here)
+            p = self.predictor(num_iteration)
+            return p.predict_contrib(X, num_features=self.max_feature_idx + 1)
         if not pred_leaf and not pred_early_stop:
             out = self._native_predict(X, num_iteration, raw_score)
             if out is not None:
@@ -1850,22 +1881,27 @@ class GBDT:
 
     def feature_importance(self, importance_type: str = "split",
                            num_iteration: int = -1) -> np.ndarray:
-        """Split-count importance (gbdt.cpp FeatureImportance)."""
+        """Split/gain importance (gbdt.cpp FeatureImportance), vectorized:
+        one concatenation over the kept trees' split arrays + one masked
+        bincount instead of the historical trees x splits Python loop
+        (reference-parity pinned in tests/test_metrics.py)."""
         n_feat = self.max_feature_idx + 1
-        out = np.zeros(n_feat, dtype=np.float64)
         trees = self.models
         if num_iteration > 0:
             cut = (num_iteration + (1 if self.boost_from_average_ else 0)) \
                 * self.num_class
             trees = trees[:cut]
-        for tree in trees:
-            for i in range(tree.num_leaves - 1):
-                if tree.split_gain[i] > 0:
-                    if importance_type == "gain":
-                        out[tree.split_feature[i]] += tree.split_gain[i]
-                    else:
-                        out[tree.split_feature[i]] += 1
-        return out
+        split_trees = [t for t in trees if t.num_leaves > 1]
+        if not split_trees:
+            return np.zeros(n_feat, dtype=np.float64)
+        feats = np.concatenate([t.split_feature[:t.num_leaves - 1]
+                                for t in split_trees])
+        gains = np.concatenate([t.split_gain[:t.num_leaves - 1]
+                                for t in split_trees])
+        mask = gains > 0
+        weights = gains[mask] if importance_type == "gain" else None
+        return np.bincount(feats[mask], weights=weights,
+                           minlength=n_feat).astype(np.float64)
 
     def save_model_to_string(self, num_iteration: int = -1) -> str:
         """gbdt.cpp:948-997 SaveModelToString — reference text format."""
@@ -1895,13 +1931,40 @@ class GBDT:
             buf.write("\n")
         buf.write("\nfeature importances:\n")
         # importances over the KEPT trees only (gbdt.cpp:989
-        # FeatureImportance(num_used_model))
-        imp = self.feature_importance(num_iteration=num_iteration)
+        # FeatureImportance(num_used_model)); saved_feature_importance_type
+        # = 1 writes total gain at full precision — the reference's int
+        # truncation only applies to split counts, which ARE integers
+        gain_mode = self.config.saved_feature_importance_type == 1
+        imp = self.feature_importance(
+            importance_type="gain" if gain_mode else "split",
+            num_iteration=num_iteration)
         order = np.argsort(-imp, kind="mergesort")
         for f in order:
             if imp[f] > 0:
-                buf.write(f"{self.feature_names[f]}={int(imp[f])}\n")
+                val = repr(float(imp[f])) if gain_mode else int(imp[f])
+                buf.write(f"{self.feature_names[f]}={val}\n")
+        dist = self._training_distribution()
+        if dist:
+            buf.write("\n")
+            buf.write(obs_model_quality.format_distribution(dist))
         return buf.getvalue()
+
+    def _training_distribution(self):
+        """Training-set bin distribution for the serving drift monitor —
+        computed once (host bincounts over the already-binned matrix)
+        when the model-quality plane is armed, then cached; loaded
+        models carry the parsed section instead."""
+        if self.feature_distribution is not None:
+            return self.feature_distribution
+        if not obs_model_quality.get_tracker().enabled:
+            return None
+        try:
+            self.feature_distribution = \
+                obs_model_quality.training_bin_distribution(self.train_set)
+        except Exception as e:      # never fail a model save over telemetry
+            log.debug("training distribution unavailable (%s)", e)
+            self.feature_distribution = {}
+        return self.feature_distribution
 
     def save_model(self, filename: str, num_iteration: int = -1) -> None:
         with open(filename, "w") as f:
@@ -1957,6 +2020,12 @@ class GBDT:
             booster.models.append(Tree.from_string(b))
         booster.num_init_iteration = len(booster.models) // max(booster.num_class, 1)
         booster.iter_ = 0
+        # optional trailing sections (the tree-block loop above stops at
+        # "feature importances"): the training bin distribution feeds the
+        # serving drift monitor
+        dist = obs_model_quality.parse_distribution(lines)
+        if dist:
+            booster.feature_distribution = dist
         return booster
 
 

@@ -261,8 +261,26 @@ class Config:
                                    # ranks' streams for straggler
                                    # detection; "" = off
     straggler_factor: float = 4.0  # supervisor straggler verdict: a rank whose flight-stream progress rate falls this factor behind the group median raises a structured rank_straggler event (requires obs_stream_path; must be > 1)
-    convert_model: str = "gbdt_prediction.cpp"
+    model_quality: str = "auto"    # model-quality observability plane
+                                   # (obs/model_quality.py, docs/
+                                   # OBSERVABILITY.md "Model quality"):
+                                   # per-split audit records into the
+                                   # flight stream, per-feature gain /
+                                   # split-count metrics gauges, and eval
+                                   # values on progress records.  auto =
+                                   # armed whenever telemetry is armed;
+                                   # on | off force it.  Pure host-side
+                                   # folds over arrays the trainer already
+                                   # fetched — zero added device syncs or
+                                   # collectives (pinned)
+    convert_model: str = "gbdt_prediction.cpp"  # convert_model task (cli.py) output path
     convert_model_language: str = ""
+    saved_feature_importance_type: int = 0  # importance type written to the
+                                   # "feature importances:" model-file
+                                   # section: 0 = split counts (reference
+                                   # default), 1 = total gain (written at
+                                   # full float precision, not truncated
+                                   # to int)
 
     # robustness (docs/ROBUSTNESS.md)
     nonfinite_policy: str = "raise"  # guard on non-finite grad/hess/leaf
@@ -396,6 +414,20 @@ class Config:
                                      # atomically between microbatches
                                      # ("" = no watching)
     model_watch_interval: float = 1.0  # seconds between model_watch polls
+    drift_threshold: float = 0.2     # serving feature-drift alarm level:
+                                     # a feature whose PSI (population
+                                     # stability index) between the
+                                     # training-set bin distribution and
+                                     # the current serving window exceeds
+                                     # this fires one `feature_drift`
+                                     # structured event per window and
+                                     # moves the lgbm_tpu_feature_drift
+                                     # gauge; <= 0 disables the event
+                                     # (gauges still export)
+    drift_window_rows: int = 4096    # serving rows accumulated per drift
+                                     # comparison window before the PSI is
+                                     # recomputed and the histograms reset
+                                     # (must be > 0)
     serving_traversal: str = "auto"  # serving-engine tree traversal:
                                      # auto | xla | packed.  ``packed``
                                      # folds each node's fields into one
@@ -590,7 +622,7 @@ def canonicalize_params(params: Optional[Dict[str, Any]]) -> Dict[str, Any]:
             aliased[PARAM_ALIASES[k]] = value
         elif k in _FIELD_TYPES:
             out[k] = value
-        elif k in ("objective_seed", "saved_feature_importance_type"):
+        elif k in ("objective_seed",):
             continue  # tolerated no-ops
         else:
             raise ValueError(f"Unknown parameter: {key}")
@@ -683,6 +715,15 @@ def check_param_conflicts(cfg: Config) -> None:
     if cfg.serving_traversal not in ("auto", "xla", "packed"):
         log.fatal("serving_traversal must be auto, xla, or packed; got %r",
                   cfg.serving_traversal)
+    if cfg.model_quality not in ("auto", "on", "off"):
+        log.fatal("model_quality must be auto, on, or off; got %r",
+                  cfg.model_quality)
+    if cfg.drift_window_rows <= 0:
+        log.fatal("drift_window_rows must be > 0 serving rows per PSI "
+                  "window; got %d", cfg.drift_window_rows)
+    if cfg.saved_feature_importance_type not in (0, 1):
+        log.fatal("saved_feature_importance_type must be 0 (split) or "
+                  "1 (gain); got %d", cfg.saved_feature_importance_type)
     if cfg.ordered_bins not in ("auto", "on", "off"):
         log.fatal("ordered_bins must be auto, on, or off; got %r",
                   cfg.ordered_bins)

@@ -217,6 +217,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if metrics_port > 0:
         obs_metrics.start_exporter(metrics_port + rank)
         exporter_armed = True
+    # ---- the model-quality plane (split audit + importance gauges) ----
+    # A pure host-side fold over arrays the boosting loop has ALREADY
+    # fetched (the tree finalize drain), so arming it adds zero device
+    # syncs and zero collectives (pinned).  model_quality=auto follows
+    # the telemetry switch; on/off force it.
+    from .obs import model_quality as obs_model_quality
+    mq_armed = obs_model_quality.resolve_armed(
+        booster.inner.config.model_quality, telemetry_on)
+    if mq_armed:
+        obs_model_quality.start(list(booster.inner.feature_names))
     ckpt_callbacks = cbs_before + cbs_after   # stable capture/restore order
     # elastic groups (docs/ROBUSTNESS.md): opt-in acceptance of committed
     # sets written at a DIFFERENT process count
@@ -523,7 +533,25 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # BEFORE the trace writes its final counter snapshot, so the
             # trace file carries the whole memory story
             obs_memory.stop()
+            if mq_armed:
+                # model-quality summary (top features by gain, gain-decay
+                # curve) rides the trace like the device_profile block so
+                # one file carries the whole training story
+                obs_trace.get_tracer().summary(
+                    "model_quality",
+                    obs_model_quality.get_tracker().summary())
             obs_trace.stop()
+        if mq_armed:
+            # cache the training bin distribution on the booster while
+            # the plane is still armed — later model saves embed it for
+            # the serving drift monitor (one host bincount pass)
+            try:
+                booster.inner._training_distribution()
+            except Exception as e:   # telemetry is best-effort
+                log.debug("training distribution unavailable: %s", e)
+            # after the trace summary (needs the live tracker) but before
+            # the flight stop — the tracker itself never writes at stop
+            obs_model_quality.stop()
         if exporter_armed:
             obs_metrics.stop_exporter()
         if flight_armed:

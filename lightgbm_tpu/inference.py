@@ -244,6 +244,48 @@ class SoABundle:
                 self.left, self.right, self.is_cat, self.cat_ref,
                 self.cat_mask)
 
+    def host_nodes(self) -> Dict[str, np.ndarray]:
+        """Host copies of the routing arrays (fetched once, cached) —
+        the contribution path replays per-node decisions as cheap host
+        integer compares over device-binned rows."""
+        cached = getattr(self, "_host_nodes", None)
+        if cached is None:
+            cached = {name: np.asarray(arr) for name, arr in zip(
+                ("feat", "thr", "dl", "miss", "lc", "rc", "ic", "cref",
+                 "cmask"), self.device_args())}
+            self._host_nodes = cached
+        return cached
+
+    def go_matrix(self, t: int, num_nodes: int, bins: np.ndarray,
+                  cats: np.ndarray, nanm: np.ndarray,
+                  zerom: np.ndarray) -> np.ndarray:
+        """go-left per (internal node, row) of tree ``t`` from binned
+        rows — integer-for-integer the ``_traverse`` routing decision,
+        evaluated for every node instead of only the visited ones (the
+        TreeSHAP recursion needs the hot child at each node)."""
+        h = self.host_nodes()
+        n = bins.shape[0]
+        go = np.zeros((num_nodes, n), bool)
+        w = h["cmask"].shape[1]
+        for i in range(num_nodes):
+            f = int(h["feat"][t, i])
+            b = bins[:, f]
+            is_nan = nanm[:, f]
+            mt = int(h["miss"][t, i])
+            nan_missing = is_nan if mt == MISSING_NAN \
+                else np.zeros(n, bool)
+            missing = nan_missing | (zerom[:, f] if mt == MISSING_ZERO
+                                     else False)
+            gl = np.where(missing, bool(h["dl"][t, i]),
+                          b <= int(h["thr"][t, i]))
+            if h["ic"][t, i]:
+                c = cats[:, f]
+                cm = h["cmask"][int(h["cref"][t, i]),
+                                np.clip(c, 0, w - 1)]
+                gl = (~nan_missing) & (c >= 0) & (c < w) & cm
+            go[i] = gl
+        return go
+
     # -------------------------------------------------- host-side binning
 
     def bin_host(self, xc: np.ndarray):
@@ -406,6 +448,45 @@ def _leaves_from_binned_packed_impl(bins, cats, nanm, zerom, w0s, w1s,
                             w0s, w1s, depth)
 
 
+# ------------------------------------------------- auxiliary device kernels
+#
+# Model-quality plane (obs/model_quality.py): the binning stage of the
+# raw-input traversal factored out standalone.  ``_bin_arrays`` hands the
+# device-binned rows to the host TreeSHAP recursion
+# (``pred_contrib=True``); ``_bin_hist`` folds one microbatch into
+# per-feature threshold-rank histograms with a single scatter-add — the
+# serving drift monitor's window accumulator.  Deliberately NOT counted
+# by :func:`jit_entries`: that gauge pins the serving *traversal*
+# executable set, which these do not touch.
+
+
+def _bin_arrays_impl(x, thr_table):
+    nanm = jnp.isnan(x)
+    xz = jnp.where(nanm, jnp.float32(0), x)
+    zerom = jnp.abs(xz) <= jnp.float32(_ZERO_RANGE_F32)
+    bins = jax.vmap(lambda t, v: jnp.searchsorted(t, v, side="left"),
+                    in_axes=(0, 1), out_axes=1)(thr_table, xz)
+    return bins.astype(jnp.int32), xz.astype(jnp.int32), nanm, zerom
+
+
+def _bin_hist_impl(x, thr_table, valid):
+    nanm = jnp.isnan(x)
+    xz = jnp.where(nanm, jnp.float32(0), x)
+    bins = jax.vmap(lambda t, v: jnp.searchsorted(t, v, side="left"),
+                    in_axes=(0, 1), out_axes=1)(thr_table, xz)
+    bins = bins.astype(jnp.int32)
+    nb1 = thr_table.shape[1] + 1
+    vi = valid.astype(jnp.int32)
+    return jax.vmap(
+        lambda b: jnp.zeros((nb1,), jnp.int32).at[b].add(vi),
+        in_axes=1)(bins)                                    # [Fc, NB+1]
+
+
+@functools.lru_cache(maxsize=None)
+def _aux_jitted():
+    return jax.jit(_bin_arrays_impl), jax.jit(_bin_hist_impl)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted(donate: bool):
     if donate:
@@ -495,6 +576,10 @@ class PredictEngine:
             raise ValueError(f"predict engine traversal must be auto, xla, "
                              f"or packed; got {traversal!r}")
         self.traversal = self._resolve_traversal(traversal)
+        # serving drift monitor (obs/model_quality.DriftMonitor); attached
+        # by the ModelServer when the model carries a training
+        # distribution — every microbatch's binned rows fold into it
+        self.drift = None
         if prewarm:
             self.prewarm()
 
@@ -631,8 +716,13 @@ class PredictEngine:
                 xp[:n, :bundle.num_cols] = xc.astype(np.float32)
                 dev_in = (jax.device_put(xp),) + self._raw_args()
                 fn = self._raw_fn()
+                if self.drift is not None:
+                    self.drift.add_counts(np.asarray(_aux_jitted()[1](
+                        xp, bundle.thr_table, np.arange(nb) < n)), n)
             else:
                 bins, cats, nanm, zerom = bundle.bin_host(xc)
+                if self.drift is not None:
+                    self.drift.add_bins(bins)
                 pad = ((0, nb - n), (0, max(bundle.num_cols, 1) - xc.shape[1]))
                 dev_in = tuple(jax.device_put(np.pad(a, pad))
                                for a in (bins, cats, nanm, zerom)) \
@@ -667,6 +757,44 @@ class PredictEngine:
             out[:, lo:lo + chunk.shape[0]] = self._run_bucket(chunk, f32_safe)
         return out
 
+    # ---------------------------------------------------------- binned rows
+
+    def binned_arrays(self, X: np.ndarray):
+        """Device-binned rows ``(bins, cats, nanm, zerom)`` in compact-
+        column rank space, each [N, Fc] — the ``pred_contrib`` traversal
+        rides these through the same bucket ladder / f32-safety
+        discipline as :meth:`leaves`, so the per-node decisions replayed
+        from them route identically to the serving traversal."""
+        X = np.atleast_2d(np.asarray(X, np.float64))
+        bundle = self.bundle
+        fc = max(bundle.num_cols, 1)
+        xc = X[:, bundle.cols] if len(bundle.cols) else \
+            np.zeros((X.shape[0], 0), np.float64)
+        with np.errstate(invalid="ignore"):
+            f32_safe = bool(np.all((xc == xc.astype(np.float32)
+                                    .astype(np.float64)) | np.isnan(xc)))
+        n = X.shape[0]
+        bins = np.zeros((n, fc), np.int32)
+        cats = np.zeros((n, fc), np.int32)
+        nanm = np.zeros((n, fc), bool)
+        zerom = np.zeros((n, fc), bool)
+        step = self.max_bucket
+        for lo in range(0, n, step):
+            chunk = xc[lo:lo + step]
+            m = chunk.shape[0]
+            if f32_safe:
+                nb = self._bucket_rows(m)
+                xp = np.zeros((nb, fc), np.float32)
+                xp[:m, :bundle.num_cols] = chunk.astype(np.float32)
+                out = _aux_jitted()[0](xp, bundle.thr_table)
+                for dst, arr in zip((bins, cats, nanm, zerom), out):
+                    dst[lo:lo + m] = np.asarray(arr)[:m]
+            else:
+                for dst, arr in zip((bins, cats, nanm, zerom),
+                                    bundle.bin_host(chunk)):
+                    dst[lo:lo + m, :arr.shape[1]] = arr
+        return bins, cats, nanm, zerom
+
     # ------------------------------------------------------------- scores
 
     def raw_scores(self, X: np.ndarray,
@@ -683,6 +811,11 @@ class PredictEngine:
         if self._native is not None:
             with self.timers.phase("predict_traverse"):
                 x = np.atleast_2d(np.asarray(X, np.float64))
+                if self.drift is not None and len(bundle.cols):
+                    # the native traversal never bins — fold the window
+                    # histogram from a host bin pass over the compact
+                    # columns so drift sees the same rank space
+                    self.drift.add_bins(bundle.bin_host(x[:, bundle.cols])[0])
                 out = self._native.predict(x, num_iteration=total // k,
                                            raw_score=True)
                 out = out[None, :] if out.ndim == 1 \

@@ -23,8 +23,10 @@ from typing import Any, Dict, List, Optional
 # --json output schema: 2 added the schema stamp itself plus the per-file
 # serving_stats / hlo_collectives entries (the multi-rank merge parity of
 # the markdown report); 3 added the per-file device_profile entry (the
-# obs/devprof.py attribution block embedded as a telemetry.summary event)
-REPORT_SCHEMA_VERSION = 3
+# obs/devprof.py attribution block embedded as a telemetry.summary event);
+# 4 added the per-file model_quality entry (obs/model_quality.py tracker
+# summary: per-feature cumulative gain, gain-decay curve)
+REPORT_SCHEMA_VERSION = 4
 
 
 def load_events(path: str) -> List[dict]:
@@ -298,6 +300,45 @@ def _devprof_lines(events: List[dict],
     return lines
 
 
+def _model_quality_lines(events: List[dict],
+                         rank: Optional[int] = None) -> List[str]:
+    """The report's Model quality section: the ``model_quality`` summary
+    the tracker embeds at teardown — per-feature cumulative split gain
+    (the what-did-the-model-learn answer) and the gain-decay curve (is
+    more boosting still buying anything)."""
+    mq = summary_payload(events, "model_quality")
+    if not mq:
+        return []
+    title = "## Model quality" + \
+        (f" — rank {rank}" if rank is not None else "")
+    lines = ["", title, "",
+             f"{mq.get('trees_seen', 0)} tree(s) audited.  Top features "
+             "by cumulative split gain:", ""]
+    top = mq.get("top_features", [])
+    if top:
+        total = sum(float(t.get("gain", 0)) for t in top) or 1.0
+        lines += _md_table(
+            ["feature", "gain", "share of top-K", "splits"],
+            [[t.get("feature"), f"{float(t.get('gain', 0)):.4g}",
+              f"{float(t.get('gain', 0)) / total:.1%}",
+              t.get("splits")] for t in top])
+    else:
+        lines.append("(no splits audited)")
+    curve = mq.get("gain_curve", [])
+    if len(curve) >= 2:
+        # decay verdict: last-quartile gain vs first-quartile gain — a
+        # ratio near zero says late iterations stopped learning
+        gains = [float(g) for _, g in curve]
+        q = max(len(gains) // 4, 1)
+        head, tail = sum(gains[:q]) / q, sum(gains[-q:]) / q
+        lines += ["", f"Gain decay over {len(curve)} iteration(s): "
+                      f"first-quartile mean {head:.4g} → last-quartile "
+                      f"mean {tail:.4g}"
+                      + (f" ({tail / head:.1%} retained)." if head > 0
+                         else ".")]
+    return lines
+
+
 def render(path) -> str:
     paths = [path] if isinstance(path, str) else list(path)
     ranked = load_events_ranked(paths)
@@ -423,8 +464,10 @@ def render(path) -> str:
     if multi:
         for p, rank, evs in ranked:
             lines += _devprof_lines(evs, rank=rank)
+            lines += _model_quality_lines(evs, rank=rank)
     else:
         lines += _devprof_lines(events)
+        lines += _model_quality_lines(events)
     lines += _memory_lines(snap)
     events_list = snap.get("events", [])
     if events_list:
@@ -477,6 +520,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                      "serving stats"),
                     "device_profile": summary_payload(events,
                                                       "device_profile"),
+                    "model_quality": summary_payload(events,
+                                                     "model_quality"),
                     "hlo_collectives": summary.get("counters", {}).get(
                         "hlo_collective_calls", {}),
                     "events_dropped": summary.get("events_dropped", 0),

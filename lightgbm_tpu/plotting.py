@@ -36,8 +36,13 @@ def plot_importance(booster, ax=None, height: float = 0.2,
                     importance_type: str = "split",
                     max_num_features: Optional[int] = None,
                     ignore_zero: bool = True, figsize=None, grid: bool = True,
+                    precision: Optional[int] = 3,
                     **kwargs):
-    """Horizontal bar chart of feature importance (plotting.py:22-120)."""
+    """Horizontal bar chart of feature importance (plotting.py:22-120).
+
+    ``importance_type="gain"`` values are float64 cumulative gains (the
+    vectorized ``GBDT.feature_importance``) — they annotate with
+    ``precision`` decimals instead of the split-count integer form."""
     try:
         import matplotlib.pyplot as plt
     except ImportError:
@@ -63,7 +68,9 @@ def plot_importance(booster, ax=None, height: float = 0.2,
     ylocs = np.arange(len(values))
     ax.barh(ylocs, values, align="center", height=height, **kwargs)
     for x, y in zip(values, ylocs):
-        ax.text(x + 1, y, str(x), va="center")
+        ax.text(x + 1, y,
+                _float2str(x, precision) if importance_type == "gain"
+                else str(int(x)), va="center")
     ax.set_yticks(ylocs)
     ax.set_yticklabels(labels)
     if xlim is not None:
@@ -76,6 +83,59 @@ def plot_importance(booster, ax=None, height: float = 0.2,
     else:
         ylim = (-1, len(values))
     ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_contrib_summary(booster, data, ax=None, height: float = 0.2,
+                         max_num_features: Optional[int] = None,
+                         title: Optional[str] = "Feature contributions",
+                         xlabel: Optional[str] = "mean |SHAP contribution|",
+                         ylabel: Optional[str] = "Features",
+                         precision: Optional[int] = 3, figsize=None,
+                         grid: bool = True, **kwargs):
+    """Horizontal bar chart of mean absolute SHAP contributions over
+    ``data`` (the ``plot_split_value_histogram``-style summary view of
+    ``predict(pred_contrib=True)``): per-feature mean |phi|, classes
+    aggregated, the expected-value column dropped."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot "
+                          "contributions.")
+
+    booster = _get_booster(booster)
+    contribs = np.asarray(booster.predict(data, pred_contrib=True))
+    feature_name = booster.feature_name()
+    n_feat = len(feature_name)
+    # [n, K*(F+1)] class-major -> mean |phi| per feature across rows and
+    # classes; the last column of every class block is the expected value
+    per_class = contribs.reshape(contribs.shape[0], -1, n_feat + 1)
+    mean_abs = np.abs(per_class[:, :, :n_feat]).mean(axis=(0, 1))
+
+    tuples = sorted(zip(feature_name, mean_abs), key=lambda x: x[1])
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples) if tuples else ((), ())
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x, y, _float2str(x, precision), va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    ax.set_xlim((0, max(values) * 1.1 if values else 1))
+    ax.set_ylim((-1, len(values)))
     if title is not None:
         ax.set_title(title)
     if xlabel is not None:

@@ -283,6 +283,42 @@ class Predictor:
             return out[0]
         return out.T  # [N, K] like the reference python package
 
+    def predict_contrib(self, X: np.ndarray,
+                        num_features: Optional[int] = None) -> np.ndarray:
+        """TreeSHAP feature contributions ``[N, K * (num_features + 1)]``
+        (``pred_contrib=True``; gbdt.cpp PredictContrib semantics): per
+        class, per-feature SHAP values plus the expected value in the
+        last column, summing to the raw margin to float roundoff.
+
+        With an attached engine the per-node decisions come from ONE
+        device binning pass over the bucket ladder (the serving rank
+        space — identical routing to the serving traversal); without one
+        they are replayed from raw features host-side.  Both ride the
+        vectorized row-parallel TreeSHAP recursion in
+        :mod:`lightgbm_tpu.obs.model_quality`; the per-row recursive
+        oracle (``contribs_oracle``) is the pinned parity twin."""
+        from .obs import model_quality as mq
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        n = X.shape[0]
+        if num_features is None:
+            num_features = X.shape[1]
+        total = self.num_iteration * self.k
+        phi = np.zeros((n, self.k, num_features + 1), np.float64)
+        binned = self.engine.binned_arrays(X) if self.engine is not None \
+            else None
+        for t in range(total):
+            tree = self.trees[t]
+            nn = tree.num_leaves - 1
+            if binned is not None and nn > 0:
+                go = self.engine.bundle.go_matrix(t, nn, *binned)
+                mq.tree_contribs(tree, go, num_features, phi[:, t % self.k])
+            else:
+                mq.contribs_from_raw(tree, X, num_features,
+                                     phi[:, t % self.k])
+        if self.average_output and self.num_iteration > 0:
+            phi /= self.num_iteration
+        return phi.reshape(n, self.k * (num_features + 1))
+
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
         total = self.num_iteration * self.k
         if self.engine is not None:

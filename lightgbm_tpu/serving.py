@@ -142,6 +142,9 @@ class ModelServer:
         self.buckets = parse_serving_buckets(cfg.serving_buckets)
         self.watch_prefix = str(cfg.model_watch or "")
         self.watch_interval = float(cfg.model_watch_interval)
+        self.drift_threshold = float(cfg.drift_threshold)
+        self.drift_window_rows = int(cfg.drift_window_rows)
+        self._drift = None
         if booster is None and model_file is None and model_str is None \
                 and not self.watch_prefix:
             raise ValueError("ModelServer needs a booster, model_file, "
@@ -188,11 +191,30 @@ class ModelServer:
         gbdt = getattr(booster, "inner", booster)
         engine = gbdt.predict_engine(prewarm=prewarm, buckets=self.buckets)
         predictor = gbdt.predictor()
+        # serving-time drift watchdog (docs/OBSERVABILITY.md "Model
+        # quality"): armed only when the model text carried a
+        # feature_distribution section (written by a model-quality-armed
+        # training) — attached BEFORE the swap so the first dispatched
+        # batch is already counted
+        drift = None
+        dist = getattr(gbdt, "feature_distribution", None)
+        if dist:
+            from .obs import model_quality as obs_model_quality
+            drift = obs_model_quality.DriftMonitor(
+                engine.bundle, dist,
+                feature_names=list(getattr(gbdt, "feature_names", []) or []),
+                threshold=self.drift_threshold,
+                window_rows=self.drift_window_rows)
+            if drift.enabled:
+                engine.drift = drift
+            else:
+                drift = None
         with self._lock:
             first = self._predictor is None
             self._booster = booster
             self._engine = engine
             self._predictor = predictor
+            self._drift = drift
             self.loaded_iteration = iteration
         if not first:
             self.stats_.record_swap()
@@ -261,6 +283,9 @@ class ModelServer:
         s = self.stats_.summary()
         s["loaded_iteration"] = self.loaded_iteration
         s["predict_jit_entries"] = _jit_entries_gauge()
+        drift = self._drift
+        if drift is not None:
+            s["drift"] = drift.stats()
         return s
 
     def _metrics_samples(self) -> List[tuple]:
@@ -297,6 +322,9 @@ class ModelServer:
                         "gauge"))
             out.append(("serving_latency_ms_count", labels,
                         float(rec["count"]), "gauge"))
+        drift = self._drift
+        if drift is not None:
+            out.extend(drift.samples())
         return out
 
     # ---------------------------------------------------------- dispatcher

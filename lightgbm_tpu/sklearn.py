@@ -335,7 +335,7 @@ class LGBMClassifier(LGBMModel, ClassifierMixin):
                 **kwargs):
         result = self.predict_proba(X, raw_score=raw_score,
                                     num_iteration=num_iteration, **kwargs)
-        if raw_score or kwargs.get("pred_leaf"):
+        if raw_score or kwargs.get("pred_leaf") or kwargs.get("pred_contrib"):
             return result
         idx = np.argmax(result, axis=1) if result.ndim == 2 \
             else (result > 0.5).astype(np.int64)
@@ -345,7 +345,7 @@ class LGBMClassifier(LGBMModel, ClassifierMixin):
                       num_iteration: int = -1, **kwargs) -> np.ndarray:
         result = super().predict(X, raw_score=raw_score,
                                  num_iteration=num_iteration, **kwargs)
-        if raw_score or kwargs.get("pred_leaf"):
+        if raw_score or kwargs.get("pred_leaf") or kwargs.get("pred_contrib"):
             return result
         if result.ndim == 1:  # binary: P(y=1)
             return np.vstack([1.0 - result, result]).T

@@ -41,6 +41,10 @@ Verdicts (entries are taken in the given CLI order = time order):
   fraction grew more than 0.15 absolute over the median of its
   predecessors → FAIL (the double-buffered pipeline is hiding less of
   the host→device copy);
+* ``importance_flip`` — within one metric identity, consecutive entries'
+  ``model_quality`` blocks name different top-gain features → warn (the
+  learned model changed at the same config: data or determinism drift,
+  not an infra regression — the throughput verdicts stay the gate);
 * ``device_profile_coverage`` — how many entries carry the devprof
   attribution block → info (the capture-backlog freshness view).
 
@@ -121,7 +125,7 @@ def normalize(raw, label):
     entry = {"label": label, "probe_failed": False, "run_failed": False,
              "rc": 0, "value": None, "metric": None, "kernel": None,
              "memory_peak": None, "device_profile": None,
-             "stall_fraction": None}
+             "stall_fraction": None, "top_gain_feature": None}
     if not isinstance(raw, dict):
         entry["run_failed"] = True
         return entry
@@ -160,6 +164,12 @@ def normalize(raw, label):
         entry["stall_fraction"] = (float(sf)
                                    if isinstance(sf, (int, float))
                                    else None)
+        # model-quality block (obs/model_quality.py summary): the
+        # top-cumulative-gain feature, tracked for same-config flips
+        top = ((parsed.get("model_quality") or {}).get("top_features")
+               or [{}])[0]
+        tg = top.get("feature")
+        entry["top_gain_feature"] = str(tg) if tg else None
     return entry
 
 
@@ -253,6 +263,17 @@ def verdicts(entries, drift_pct=15.0, memory_pct=25.0, streak_min=2):
                     f"median of {len(prev)} prior round(s) "
                     f"(threshold {memory_pct:g}%)",
                     rounds=[e["label"] for e in peaks]))
+        tops = [e for e in group if e.get("top_gain_feature")]
+        for a, b in zip(tops, tops[1:]):
+            if a["top_gain_feature"] != b["top_gain_feature"]:
+                # the learned model, not the machinery: warn, never fail
+                findings.append(_finding(
+                    "importance_flip", WARN,
+                    f"{metric}: top-gain feature flipped "
+                    f"{a['top_gain_feature']} -> {b['top_gain_feature']} "
+                    f"between {a['label']} and {b['label']} at the same "
+                    "config — the learned model shifted",
+                    rounds=[a["label"], b["label"]]))
         stalls = [e for e in group if e["stall_fraction"] is not None]
         if len(stalls) >= 3:
             # absolute creep on the [0,1] fraction: the pipeline's overlap

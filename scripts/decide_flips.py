@@ -160,6 +160,26 @@ def metrics_row(d):
             f"(schema v{m.get('schema_version')})")
 
 
+def model_quality_row(d):
+    """One-line model-quality coverage summary of an artifact's
+    "model_quality" block (the obs/model_quality.py tracker summary
+    bench.py embeds next to metrics_snapshot: per-feature cumulative
+    gain, gain-decay curve).  None when the artifact predates the
+    model-quality plane."""
+    mq = d.get("model_quality")
+    if not isinstance(mq, dict):
+        return None
+    top = mq.get("top_features") or []
+    head = ", ".join(f"{t.get('feature')}={t.get('gain'):.4g}"
+                     for t in top[:3])
+    curve = mq.get("gain_curve") or []
+    decay = ""
+    if len(curve) >= 2 and curve[0][1]:
+        decay = f", gain decay x{curve[-1][1] / curve[0][1]:.3f}"
+    return (f"model_quality: {mq.get('trees_seen')} tree(s) audited"
+            f"{f', top gain: {head}' if head else ''}{decay}")
+
+
 def devprof_row(d):
     """One-line device-time coverage summary of an artifact's
     "device_profile" block (obs/devprof.py: programmatic profiler windows
@@ -349,6 +369,9 @@ def main():
     hx = metrics_row(head)
     if hx:
         print(f"{'':10}{hx}")
+    hq = model_quality_row(head)
+    if hq:
+        print(f"{'':10}{hq}")
     hd = devprof_row(head)
     if hd:
         print(f"{'':10}{hd}")
@@ -387,6 +410,9 @@ def main():
             xr = metrics_row(d)
             if xr:
                 print(f"{'':53}{xr}")
+            qr = model_quality_row(d)
+            if qr:
+                print(f"{'':53}{qr}")
             dr = devprof_row(d)
             if dr:
                 print(f"{'':53}{dr}")

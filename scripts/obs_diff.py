@@ -172,6 +172,22 @@ def compare_bench(a, b, thresholds):
     if g is not None and g > thresholds["throughput_pct"]:
         f.append(_finding("marginal_ms_per_leaf", FAIL,
                           f"deep-tree marginal cost grew {g:.1f}%", la, lb))
+    # model-quality block (obs/model_quality.py tracker summary embedded
+    # by bench.py): a changed top-gain feature at the SAME config is a
+    # learned-model shift, not an infra regression — warn, never fail
+    mqa = ((a.get("model_quality") or {}).get("top_features") or [])
+    mqb = ((b.get("model_quality") or {}).get("top_features") or [])
+    if mqa and mqb:
+        fa, fb = mqa[0].get("feature"), mqb[0].get("feature")
+        if fa != fb:
+            f.append(_finding("importance_flip", WARN,
+                              "top-gain feature changed", fa, fb))
+        else:
+            g = _pct(mqa[0].get("gain"), mqb[0].get("gain"))
+            if g is not None:
+                f.append(_finding("importance_top_gain", INFO,
+                                  f"top feature `{fa}` gain {g:+.1f}%",
+                                  mqa[0].get("gain"), mqb[0].get("gain")))
     return f
 
 
@@ -241,6 +257,23 @@ def compare_metrics(a, b, thresholds):
                                   f"grew {g:.1f}% (> {thr}%)",
                                   a[key], b[key]))
             break
+    # serving drift gauges (obs/model_quality.DriftMonitor): a candidate
+    # PSI past the canonical 0.2 alert line where the baseline was quiet
+    # is a data shift, not a code regression — warn
+    for key in sorted(k for k in b if "feature_drift" in k):
+        va, vb = a.get(key, 0.0), b[key]
+        if vb > 0.2 >= va:
+            f.append(_finding(key, WARN,
+                              "serving PSI crossed 0.2", va, vb))
+    # importance gauges: top cumulative-gain feature flip across runs
+    def _top_gain(snap):
+        gains = {k: v for k, v in snap.items()
+                 if k.startswith("lgbm_tpu_feature_gain_total")}
+        return max(gains, key=gains.get) if gains else None
+    ga, gb = _top_gain(a), _top_gain(b)
+    if ga and gb and ga != gb:
+        f.append(_finding("importance_flip", WARN,
+                          "top-gain feature label changed", ga, gb))
     return f
 
 

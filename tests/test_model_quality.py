@@ -1,0 +1,474 @@
+"""Model-quality observability plane (obs/model_quality.py): the
+split-audit flight stream, device TreeSHAP contributions
+(``predict(pred_contrib=True)``), serving-time feature drift detection,
+and the importance satellites (vectorized ``feature_importance``,
+``saved_feature_importance_type`` round-trip)."""
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import flight as obs_flight
+from lightgbm_tpu.obs import metrics as obs_metrics
+from lightgbm_tpu.obs import model_quality as mq
+from lightgbm_tpu.obs import report as obs_report
+from lightgbm_tpu.obs.counters import counters
+from lightgbm_tpu.serving import ModelServer
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _train(params, X, y, rounds=8, cat=None):
+    base = {"verbose": -1, "min_data_in_leaf": 5, "num_leaves": 15}
+    base.update(params)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False,
+                     categorical_feature=cat)
+    return lgb.train(base, ds, num_boost_round=rounds, verbose_eval=False)
+
+
+def _binary_data(seed=0, n=400, f=6, with_nan=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X @ rng.randn(f) > 0).astype(np.float64)
+    if with_nan:
+        X[::7, 2] = np.nan
+    return X, y
+
+
+# --------------------------------------------------- SHAP local accuracy
+
+
+def _assert_contrib_exact(bst, X, k, atol=1e-10):
+    """Local accuracy: per class block, contribs sum to the raw margin."""
+    n_feat = X.shape[1]
+    contribs = np.asarray(bst.predict(X, pred_contrib=True))
+    assert contribs.shape == (len(X), k * (n_feat + 1))
+    sums = contribs.reshape(len(X), k, n_feat + 1).sum(axis=-1)
+    raw = np.asarray(bst.predict(X, raw_score=True), np.float64)
+    raw = raw[:, None] if raw.ndim == 1 else raw
+    np.testing.assert_allclose(sums, raw, atol=atol, rtol=0)
+    return contribs
+
+
+@pytest.mark.parametrize("case", ["binary", "nan", "multiclass", "dart",
+                                  "categorical"])
+def test_pred_contrib_sums_to_margin(case):
+    """The exactness matrix: ``contribs.sum(axis=-1) == raw margin`` for
+    every objective/tree-shape variant, from BOTH traversal routes — the
+    host go-matrix and the serving engine's device-binned rows."""
+    if case == "multiclass":
+        rng = np.random.RandomState(3)
+        X = rng.randn(500, 6)
+        y = rng.randint(0, 5, size=500).astype(np.float64)
+        bst = _train({"objective": "multiclass", "num_class": 5}, X, y)
+        k = 5
+    elif case == "dart":
+        X, y = _binary_data(seed=4)
+        bst = _train({"objective": "binary", "boosting": "dart",
+                      "drop_rate": 0.5, "drop_seed": 7}, X, y)
+        k = 1
+    elif case == "categorical":
+        rng = np.random.RandomState(5)
+        X = rng.randn(500, 5)
+        X[:, 0] = rng.randint(0, 8, size=500)
+        y = ((X[:, 0] > 3) ^ (X[:, 1] > 0)).astype(np.float64)
+        bst = _train({"objective": "binary"}, X, y, cat=[0])
+        k = 1
+    else:
+        X, y = _binary_data(with_nan=(case == "nan"))
+        bst = _train({"objective": "binary"}, X, y)
+        k = 1
+    # host path first (no engine built yet)
+    assert bst.inner.predict_engine(build=False) is None
+    host = _assert_contrib_exact(bst, X, k)
+    # device-binned path: same bundle + bucket ladder as serving
+    bst.inner.predict_engine(prewarm=False)
+    dev = _assert_contrib_exact(bst, X, k)
+    np.testing.assert_allclose(dev, host, atol=1e-12, rtol=0)
+
+
+def test_contrib_oracle_parity():
+    """The vectorized TreeSHAP is pinned per-row against the independent
+    scalar recursion (the literal reference tree.cpp:TreeSHAP twin)."""
+    X, y = _binary_data(seed=8)
+    bst = _train({"objective": "binary"}, X, y, rounds=5)
+    n_feat = X.shape[1]
+    rows = X[:7]
+    for tree in bst.inner.models[:5]:
+        vec = mq.contribs_from_raw(tree, rows, n_feat)
+        for r in range(len(rows)):
+            orc = mq.contribs_oracle(tree, rows[r], n_feat)
+            np.testing.assert_allclose(vec[r], orc, atol=1e-12, rtol=0)
+
+
+def test_contrib_expected_value_column():
+    """The bias column carries the cover-weighted mean output, and the
+    sklearn surface passes raw contributions through untransformed."""
+    X, y = _binary_data(seed=9)
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7,
+                             min_data_in_leaf=5, verbose=-1)
+    clf.fit(X, y)
+    contribs = clf.predict(X, pred_contrib=True)
+    assert contribs.shape == (len(X), X.shape[1] + 1)
+    expect = sum(mq.expected_value(t) for t in clf.booster_.inner.models)
+    np.testing.assert_allclose(contribs[:, -1], expect, atol=1e-12)
+
+
+# ------------------------------------------------- importance satellites
+
+
+def _importance_loop(gbdt, importance_type, num_iteration=-1):
+    """The historical trees x splits Python loop — the reference
+    semantics (gbdt.cpp FeatureImportance) the vectorized path is pinned
+    against."""
+    n_feat = gbdt.max_feature_idx + 1
+    trees = gbdt.models
+    if num_iteration > 0:
+        cut = (num_iteration + (1 if gbdt.boost_from_average_ else 0)) \
+            * gbdt.num_class
+        trees = trees[:cut]
+    imp = np.zeros(n_feat, np.float64)
+    for t in trees:
+        for i in range(t.num_leaves - 1):
+            if t.split_gain[i] > 0:
+                imp[t.split_feature[i]] += \
+                    t.split_gain[i] if importance_type == "gain" else 1.0
+    return imp
+
+
+@pytest.mark.parametrize("importance_type", ["split", "gain"])
+@pytest.mark.parametrize("num_iteration", [-1, 3])
+def test_feature_importance_vectorized_parity(importance_type,
+                                              num_iteration):
+    X, y = _binary_data(seed=11, f=8)
+    bst = _train({"objective": "binary"}, X, y)
+    got = bst.feature_importance(importance_type,
+                                 iteration=num_iteration)
+    ref = _importance_loop(bst.inner, importance_type, num_iteration)
+    np.testing.assert_allclose(got, ref, rtol=1e-12, atol=0)
+    if importance_type == "split":
+        assert np.array_equal(got, got.astype(np.int64))
+
+
+def test_saved_feature_importance_type_gain_roundtrip():
+    """``saved_feature_importance_type=1`` writes TOTAL GAIN at full
+    float precision into the model text's ``feature importances:``
+    section (the reference's int truncation only applies to split
+    counts), and the values survive a save/load round trip."""
+    X, y = _binary_data(seed=12, f=5)
+    bst = _train({"objective": "binary",
+                  "saved_feature_importance_type": 1}, X, y)
+    gains = bst.feature_importance("gain")
+    txt = bst.model_to_string()
+    section = txt.split("feature importances:", 1)[1].strip().splitlines()
+    saved = {}
+    for line in section:
+        if "=" not in line:
+            break
+        name, val = line.split("=", 1)
+        saved[name] = float(val)
+    names = bst.feature_name()
+    for i, g in enumerate(gains):
+        if g > 0:
+            assert saved[names[i]] == g, \
+                f"gain for {names[i]} saved lossy: {saved[names[i]]} != {g}"
+    # descending order, as the reference writes them
+    vals = list(saved.values())
+    assert vals == sorted(vals, reverse=True)
+    # split mode stays integer-truncated
+    bst2 = _train({"objective": "binary"}, X, y)
+    txt2 = bst2.model_to_string()
+    line2 = txt2.split("feature importances:", 1)[1].strip().splitlines()[0]
+    assert float(line2.split("=", 1)[1]) == int(float(line2.split("=", 1)[1]))
+    # round trip: a loaded model reproduces the same gain importances
+    back = lgb.Booster(model_str=txt, params={"verbose": -1})
+    np.testing.assert_allclose(back.feature_importance("gain"), gains,
+                               rtol=1e-12, atol=0)
+
+
+# --------------------------------------------- training-side audit plane
+
+
+@pytest.fixture(scope="module")
+def mq_training(tmp_path_factory):
+    """One training with the model-quality plane armed (telemetry=true,
+    model_quality=auto) + flight stream + trace; returns (booster,
+    stream path, trace path, counter snapshot)."""
+    d = tmp_path_factory.mktemp("mq")
+    stream = str(d / "flight.jsonl")
+    trace = str(d / "trace.json")
+    X, y = _binary_data(seed=13, f=6)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5, "telemetry": True,
+                     "obs_stream_path": stream, "trace_path": trace,
+                     "pipeline_trees": False, "metric": "binary_logloss"},
+                    ds, num_boost_round=4, valid_sets=[ds],
+                    valid_names=["train"], verbose_eval=False)
+    return bst, stream, trace, counters.snapshot()
+
+
+def test_split_audit_flight_records(mq_training):
+    """Every materialized split streams one ``split_audit`` flight record
+    carrying the full decision (feature name, threshold + bin, gain,
+    child covers, default path) — reconstructable tree growth."""
+    bst, stream, _, _ = mq_training
+    recs = obs_flight.read_stream(obs_flight.stream_path(stream, 0))
+    audits = [r for r in recs if r["event"] == "split_audit"]
+    n_splits = sum(t.num_leaves - 1 for t in bst.inner.models)
+    assert len(audits) == n_splits
+    for r in audits:
+        assert r["feature"].startswith("Column_")
+        assert r["gain"] >= 0 and r["left_count"] > 0 and r["right_count"] > 0
+        assert isinstance(r["bin_threshold"], int)
+        assert isinstance(r["default_left"], bool)
+    # tree growth is auditable per iteration (0-based boosting index)
+    assert {r["iteration"] for r in audits} == {0, 1, 2, 3}
+
+
+def test_progress_records_carry_eval_values(mq_training):
+    """The per-metric eval values ride the flight stream's progress
+    records (one iteration late: the engine evaluates after update)."""
+    _, stream, _, _ = mq_training
+    recs = obs_flight.read_stream(obs_flight.stream_path(stream, 0))
+    prog = [r for r in recs if r["event"] == "progress"]
+    assert len(prog) == 4
+    with_eval = [r for r in prog if "eval" in r]
+    assert len(with_eval) >= 3       # first record predates any eval
+    for r in with_eval:
+        assert r["eval"]["training:binary_logloss"] > 0
+
+
+def test_model_quality_plane_adds_zero_collectives(mq_training):
+    """Acceptance pin: the armed audit plane reads host arrays the
+    trainer already fetched — no collective, no device sync of its own."""
+    _, _, _, snap = mq_training
+    assert snap["counters"].get("collective_calls", {}) == {}
+    assert snap["counters"].get("collective_bytes", {}) == {}
+
+
+def test_report_renders_model_quality_section(mq_training):
+    _, _, trace, _ = mq_training
+    text = obs_report.render(trace)
+    assert "Model quality" in text
+    assert "Column_" in text
+    assert "gain decay" in text.lower()
+
+
+def test_feature_gain_gauges_render():
+    """Per-feature cumulative gain/split families render on a live
+    scrape while the tracker is armed, and retire with it."""
+    X, y = _binary_data(seed=14, f=4)
+    bst = _train({"objective": "binary"}, X, y, rounds=2)
+    tracker = mq.start(["f0", "f1", "f2", "f3"])
+    try:
+        for i, t in enumerate(bst.inner.models):
+            tracker.observe_tree(i + 1, i, t)
+        body = obs_metrics.render_prometheus()
+        assert "lgbm_tpu_feature_gain_total{feature=" in body
+        assert "lgbm_tpu_feature_split_total{feature=" in body
+        parsed = obs_metrics.parse_prometheus(body)
+        gains = {k: v for k, v in parsed.items()
+                 if k.startswith("lgbm_tpu_feature_gain_total")}
+        assert sum(gains.values()) > 0
+        top = tracker.summary()["top_features"]
+        assert top and top[0]["gain"] >= top[-1]["gain"]
+    finally:
+        mq.stop()
+    # the tracker's metrics source is weakref'd: it retires with the
+    # last reference, not by explicit deregistration
+    del tracker
+    import gc
+    gc.collect()
+    assert "lgbm_tpu_feature_gain_total" not in obs_metrics.render_prometheus()
+
+
+def test_training_distribution_saved_and_parsed():
+    """A model-quality-armed training appends the binned training
+    distribution to the model text; load parses it back exactly."""
+    X, y = _binary_data(seed=15, f=4)
+    bst = _train({"objective": "binary", "model_quality": "on",
+                  "telemetry": True}, X, y, rounds=3)
+    txt = bst.model_to_string()
+    assert "feature_distribution:" in txt
+    back = lgb.Booster(model_str=txt, params={"verbose": -1})
+    dist = back.inner.feature_distribution
+    assert dist and all(sum(c for _, c in v) == len(X)
+                        for v in dist.values())
+    # disarmed training writes no section
+    bst_off = _train({"objective": "binary", "model_quality": "off"}, X, y,
+                     rounds=2)
+    assert "feature_distribution:" not in bst_off.model_to_string()
+
+
+# ------------------------------------------------------- serving drift
+
+
+def test_serving_drift_detection_e2e():
+    """The serving replay contract: a zero-drift window stays silent; a
+    shifted window fires exactly one ``feature_drift`` event for the
+    shifted (model-used) feature, moves its gauge past the threshold,
+    and the gauges appear in a live ``/metrics`` scrape."""
+    rng = np.random.default_rng(7)
+    n = 2000
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + 0.5 * X[:, 1]
+         + 0.1 * rng.normal(size=n) > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "model_quality": "on",
+                  "telemetry": True}, X, y, rounds=6)
+    txt = bst.model_to_string()
+    port = _free_port()
+    counters.reset()
+    srv = ModelServer(model_str=txt,
+                      params={"verbose": -1, "drift_threshold": 0.2,
+                              "drift_window_rows": 512,
+                              "metrics_port": port})
+    try:
+        drift = srv._drift
+        assert drift is not None and drift.enabled
+        # phase 1: serving data from the training distribution — silent
+        srv.predict(rng.normal(size=(1024, 3)))
+        assert counters.events("feature_drift") == []
+        st = srv.stats()["drift"]
+        assert st["rows_seen"] >= 1024 and st["windows"] >= 1
+        assert all(v < 0.2 for v in st["psi"].values())
+        # phase 2: Column_0 (a feature the model splits on) shifts
+        shifted = rng.normal(size=(1024, 3))
+        shifted[:, 0] += 5.0
+        srv.predict(shifted)
+        evs = counters.events("feature_drift")
+        fired = [e for e in evs if e["feature"] == "Column_0"]
+        assert len(fired) == 1, evs
+        assert fired[0]["psi"] > 0.2 == fired[0]["threshold"]
+        gauges = {lb["feature"]: v for nm, lb, v, kind in drift.samples()
+                  if nm == "feature_drift"}
+        assert gauges["Column_0"] > 0.2
+        st = srv.stats()["drift"]
+        assert st["events_fired"] == 1 and st["windows"] >= 2
+        # the live scrape carries the per-feature drift gauges
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=60) as r:
+            body = r.read().decode()
+        assert 'lgbm_tpu_feature_drift{feature="Column_0"}' in body
+        parsed = obs_metrics.parse_prometheus(body)
+        assert parsed['lgbm_tpu_feature_drift{feature="Column_0"}'] > 0.2
+        assert parsed["lgbm_tpu_drift_windows_total"] >= 2
+    finally:
+        srv.stop()
+
+
+def test_serving_without_distribution_has_no_drift_monitor():
+    """Models without a ``feature_distribution`` section (any training
+    with the plane disarmed) serve with the watchdog fully absent."""
+    X, y = _binary_data(seed=16, f=4)
+    bst = _train({"objective": "binary", "model_quality": "off"}, X, y,
+                 rounds=2)
+    srv = ModelServer(model_str=bst.model_to_string(),
+                      params={"verbose": -1})
+    try:
+        assert srv._drift is None
+        srv.predict(X[:8])
+        assert "drift" not in srv.stats()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------ CI plumbing
+
+
+def _load_script(name):
+    import importlib.util
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mq_block(top_feature):
+    return {"model_quality": {
+        "trees_seen": 4,
+        "top_features": [{"feature": top_feature, "gain": 9.5, "splits": 6},
+                         {"feature": "f1", "gain": 2.0, "splits": 3}],
+        "gain_curve": [[1, 6.0], [2, 2.5], [3, 0.8], [4, 0.2]]}}
+
+
+def test_decide_flips_model_quality_row():
+    df = _load_script("decide_flips")
+    assert df.model_quality_row({}) is None
+    row = df.model_quality_row(_mq_block("f0"))
+    assert "4 tree(s) audited" in row and "f0=9.5" in row
+    assert "gain decay" in row
+
+
+def test_bench_history_importance_flip_verdict():
+    bh = _load_script("bench_history")
+
+    def entry(label, feat):
+        doc = {"metric": "m", "value": 1.0, "unit": "trees/sec"}
+        doc.update(_mq_block(feat))
+        return bh.normalize(doc, label)
+
+    steady = [entry(f"r{i}", "f0") for i in range(3)]
+    assert not [f for f in bh.verdicts(steady)
+                if f["check"] == "importance_flip"]
+    flipped = steady + [entry("r3", "f5")]
+    finds = [f for f in bh.verdicts(flipped)
+             if f["check"] == "importance_flip"]
+    assert len(finds) == 1 and finds[0]["severity"] == "warn"
+    assert "f0" in finds[0]["detail"] and "f5" in finds[0]["detail"]
+    assert finds[0]["rounds"] == ["r2", "r3"]
+
+
+def test_obs_diff_drift_and_importance_verdicts(tmp_path):
+    import json
+    od = _load_script("obs_diff")
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    base = {"metric": "m", "value": 1.0, "unit": "trees/sec"}
+    base.update(_mq_block("f0"))
+    a.write_text(json.dumps(base))
+    cand = {"metric": "m", "value": 1.0, "unit": "trees/sec"}
+    cand.update(_mq_block("f2"))
+    b.write_text(json.dumps(cand))
+    thresholds = {"throughput_pct": 10, "latency_pct": 25,
+                  "p99_pct": 25, "memory_pct": 20}
+    _, findings = od.compare(str(a), str(b), thresholds)
+    flips = [f for f in findings if f["check"] == "importance_flip"]
+    assert flips and flips[0]["severity"] == "warn"
+    # metrics-snapshot kind: a drift gauge crossing 0.2 in the candidate
+    ma, mb = tmp_path / "ma.json", tmp_path / "mb.json"
+    key = 'lgbm_tpu_feature_drift{feature="f3"}'
+    ma.write_text(json.dumps({"schema_version": 1, "samples": {key: 0.01}}))
+    mb.write_text(json.dumps({"schema_version": 1, "samples": {key: 1.4}}))
+    _, findings = od.compare(str(ma), str(mb), thresholds)
+    drifts = [f for f in findings if "feature_drift" in f["check"]]
+    assert drifts and "f3" in drifts[0]["check"]
+    assert drifts[0]["severity"] == "warn"
+    # baseline already past the line: no new warning
+    ma.write_text(json.dumps({"schema_version": 1, "samples": {key: 0.9}}))
+    _, findings = od.compare(str(ma), str(mb), thresholds)
+    assert not [f for f in findings if "feature_drift" in f["check"]]
+
+
+def test_psi_and_distribution_text_helpers():
+    """Unit pins for the PSI arithmetic and the model-text codec."""
+    p = np.array([100, 100, 100, 100], np.float64)
+    assert mq.psi(p, p) == pytest.approx(0.0, abs=1e-9)
+    q = np.array([400, 0, 0, 0], np.float64)
+    assert mq.psi(p, q) > 0.2
+    dist = {0: [(0.5, 10), (1.5, 20)], 3: [(-1.0, 30)]}
+    lines = mq.format_distribution(dist).splitlines()
+    parsed = mq.parse_distribution(lines)
+    assert parsed == dist

@@ -35,6 +35,31 @@ def test_plot_importance(trained):
     assert len(ax2.patches) <= 5
 
 
+def test_plot_importance_gain_annotations(trained):
+    """Gain bars annotate with float values at the requested precision,
+    split bars stay integer."""
+    bst, _ = trained
+    ax = lgb.plot_importance(bst, importance_type="gain", precision=2)
+    texts = [t.get_text() for t in ax.texts]
+    assert texts and all("." in t and len(t.split(".")[1]) == 2
+                         for t in texts)
+    ax2 = lgb.plot_importance(bst, importance_type="split")
+    assert all("." not in t.get_text() for t in ax2.texts)
+
+
+def test_plot_contrib_summary(trained, binary_example):
+    bst, _ = trained
+    X = binary_example[0][:64]
+    ax = lgb.plot_contrib_summary(bst, X, max_num_features=5)
+    assert ax is not None
+    assert ax.get_title() == "Feature contributions"
+    assert ax.get_xlabel() == "mean |SHAP contribution|"
+    assert 0 < len(ax.patches) <= 5
+    # bar widths are the per-feature mean |phi|, sorted ascending
+    widths = [p.get_width() for p in ax.patches]
+    assert widths == sorted(widths) and widths[-1] > 0
+
+
 def test_plot_metric(trained):
     _, evals_result = trained
     ax = lgb.plot_metric(evals_result)
